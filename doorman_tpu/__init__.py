@@ -1,0 +1,28 @@
+"""doorman-tpu: a TPU-native framework for global distributed client-side
+rate limiting.
+
+Clients of a shared resource cooperatively obtain time-bounded capacity
+leases from master-elected servers. Where the reference system
+(/root/reference, Go) runs its apportionment algorithms per request —
+O(clients) to O(clients^2) per call — this framework recasts each refresh
+tick as ONE batched allocation solve in JAX/XLA: the master's
+(client x resource) wants table is snapshotted into device arrays and all
+resources are solved at once via vmapped proportional-share and
+water-filling fair-share kernels, sharded over a device mesh for scale.
+
+Package layout:
+    proto/        wire schema (proto3) + hand-wired gRPC service
+    algorithms/   scalar oracle implementations (parity reference)
+    solver/       batched JAX kernels + tick-level batch solver
+    parallel/     mesh + shard_map sharded solves (client axis, 2-level tree)
+    core/         lease store, resource registry, snapshots
+    server/       the capacity server (4 RPCs), config, election
+    client/       master-aware connection + refresh-loop client
+    ratelimiter/  QPS + adaptive rate limiters
+    metrics/      prometheus + /debug/status + /debug/resources
+    sim/          discrete-event simulation harness (scenarios 1-7)
+    cli/          doorman_server / doorman_client / doorman_shell
+    utils/        backoff, flagenv
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,78 @@
+"""Hand-wired gRPC service definition for the Capacity service.
+
+The build image has protoc but not the gRPC python codegen plugin, so the
+service stubs/handlers that `grpc_python_plugin` would emit are written by
+hand here. The method set mirrors the reference service
+(/root/reference/proto/doorman/doorman.proto:210-224): Discovery,
+GetCapacity, GetServerCapacity, ReleaseCapacity.
+
+Works with both `grpc` (sync) and `grpc.aio` channels/servers: the stub just
+binds serializers to method paths, and `add_capacity_servicer` registers a
+generic handler, which both server flavors accept.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from doorman_tpu.proto import doorman_pb2 as pb
+
+SERVICE_NAME = "doorman_tpu.Capacity"
+
+# method name -> (request class, response class)
+_METHODS = {
+    "Discovery": (pb.DiscoveryRequest, pb.DiscoveryResponse),
+    "GetCapacity": (pb.GetCapacityRequest, pb.GetCapacityResponse),
+    "GetServerCapacity": (pb.GetServerCapacityRequest, pb.GetServerCapacityResponse),
+    "ReleaseCapacity": (pb.ReleaseCapacityRequest, pb.ReleaseCapacityResponse),
+}
+
+
+class CapacityStub:
+    """Client-side stub; `channel` may be a sync or aio grpc channel."""
+
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class CapacityServicer:
+    """Base servicer; subclass and override the four methods.
+
+    Methods may be plain functions (sync server) or coroutines (aio server).
+    """
+
+    def Discovery(self, request, context):
+        raise NotImplementedError
+
+    def GetCapacity(self, request, context):
+        raise NotImplementedError
+
+    def GetServerCapacity(self, request, context):
+        raise NotImplementedError
+
+    def ReleaseCapacity(self, request, context):
+        raise NotImplementedError
+
+
+def add_capacity_servicer(server, servicer: CapacityServicer) -> None:
+    """Register `servicer` on a grpc or grpc.aio server."""
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+        for name, (req_cls, resp_cls) in _METHODS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )

@@ -1,0 +1,1 @@
+"""Capacity server: config, election, RPC handlers, batched tick loop."""

@@ -1,0 +1,155 @@
+"""Config layer tests; the invalid-repository cases mirror the reference's
+validation tests (/root/reference/go/server/doorman/server_test.go:30-127)."""
+
+import pytest
+
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.server import config as cfg
+
+
+def algo(kind=pb.Algorithm.PROPORTIONAL_SHARE, lease=60, refresh=16):
+    return pb.Algorithm(kind=kind, lease_length=lease, refresh_interval=refresh)
+
+
+def repo(*templates):
+    r = pb.ResourceRepository()
+    r.resources.extend(templates)
+    return r
+
+
+def star(capacity=100.0):
+    return pb.ResourceTemplate(
+        identifier_glob="*", capacity=capacity, algorithm=algo()
+    )
+
+
+class TestValidateRepository:
+    def test_valid_minimal(self):
+        cfg.validate_repository(repo(star()))
+
+    def test_missing_star(self):
+        with pytest.raises(cfg.ConfigError, match="entry for"):
+            cfg.validate_repository(
+                repo(pb.ResourceTemplate(identifier_glob="res0", capacity=1.0,
+                                         algorithm=algo()))
+            )
+
+    def test_star_not_last(self):
+        with pytest.raises(cfg.ConfigError, match="last"):
+            cfg.validate_repository(
+                repo(star(), pb.ResourceTemplate(identifier_glob="res0",
+                                                 capacity=1.0, algorithm=algo()))
+            )
+
+    def test_star_without_algorithm(self):
+        t = pb.ResourceTemplate(identifier_glob="*", capacity=1.0)
+        with pytest.raises(cfg.ConfigError, match="algorithm"):
+            cfg.validate_repository(repo(t))
+
+    def test_refresh_below_one(self):
+        t = pb.ResourceTemplate(
+            identifier_glob="*", capacity=1.0, algorithm=algo(refresh=0)
+        )
+        with pytest.raises(cfg.ConfigError, match="refresh"):
+            cfg.validate_repository(repo(t))
+
+    def test_lease_below_refresh(self):
+        t = pb.ResourceTemplate(
+            identifier_glob="*", capacity=1.0, algorithm=algo(lease=5, refresh=16)
+        )
+        with pytest.raises(cfg.ConfigError, match="[Ll]ease length"):
+            cfg.validate_repository(repo(t))
+
+    def test_malformed_glob(self):
+        t = pb.ResourceTemplate(
+            identifier_glob="[unterminated", capacity=1.0, algorithm=algo()
+        )
+        with pytest.raises(cfg.ConfigError, match="glob"):
+            cfg.validate_repository(repo(t, star()))
+
+
+class TestFindTemplate:
+    def test_exact_beats_glob(self):
+        exact = pb.ResourceTemplate(identifier_glob="res0", capacity=1.0,
+                                    algorithm=algo())
+        globby = pb.ResourceTemplate(identifier_glob="res*", capacity=2.0,
+                                     algorithm=algo())
+        r = repo(globby, exact, star())
+        assert cfg.find_template(r, "res0").capacity == 1.0
+
+    def test_first_glob_wins(self):
+        g1 = pb.ResourceTemplate(identifier_glob="res*", capacity=1.0,
+                                 algorithm=algo())
+        g2 = pb.ResourceTemplate(identifier_glob="r*", capacity=2.0,
+                                 algorithm=algo())
+        r = repo(g1, g2, star())
+        assert cfg.find_template(r, "res7").capacity == 1.0
+
+    def test_fallback_to_star(self):
+        r = repo(star(capacity=42.0))
+        assert cfg.find_template(r, "anything").capacity == 42.0
+
+
+class TestYaml:
+    def test_round_trip(self):
+        text = """
+resources:
+- identifier_glob: fair
+  capacity: 500
+  safe_capacity: 10
+  algorithm:
+    kind: FAIR_SHARE
+    lease_length: 60
+    refresh_interval: 16
+- identifier_glob: "*"
+  capacity: 100
+  algorithm:
+    kind: PROPORTIONAL_SHARE
+    lease_length: 60
+    refresh_interval: 16
+"""
+        r = cfg.parse_yaml_config(text)
+        assert len(r.resources) == 2
+        assert r.resources[0].algorithm.kind == pb.Algorithm.FAIR_SHARE
+        assert r.resources[0].HasField("safe_capacity")
+        assert r.resources[0].safe_capacity == 10
+        again = cfg.parse_yaml_config(cfg.repository_to_yaml(r))
+        assert again == r
+
+    def test_empty_doc(self):
+        with pytest.raises(cfg.ConfigError):
+            cfg.parse_yaml_config("")
+
+    def test_invalid_yaml(self):
+        with pytest.raises(cfg.ConfigError):
+            cfg.parse_yaml_config("resources: [}")
+
+
+class TestValidateRequests:
+    def test_empty_client(self):
+        req = pb.GetCapacityRequest()
+        assert cfg.validate_get_capacity_request(req) is not None
+
+    def test_negative_wants(self):
+        req = pb.GetCapacityRequest(client_id="c")
+        rr = req.resource.add()
+        rr.resource_id = "r"
+        rr.wants = -1.0
+        assert cfg.validate_get_capacity_request(req) is not None
+
+    def test_ok(self):
+        req = pb.GetCapacityRequest(client_id="c")
+        rr = req.resource.add()
+        rr.resource_id = "r"
+        rr.wants = 5.0
+        assert cfg.validate_get_capacity_request(req) is None
+
+    def test_server_capacity_bad_subclients(self):
+        req = pb.GetServerCapacityRequest(server_id="s")
+        rr = req.resource.add()
+        rr.resource_id = "r"
+        band = rr.wants.add()
+        band.priority = 0
+        band.num_clients = 0
+        band.wants = 10.0
+        assert cfg.validate_get_server_capacity_request(req) is not None
