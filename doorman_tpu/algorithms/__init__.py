@@ -1,0 +1,19 @@
+"""Scalar (per-request) allocation algorithms.
+
+These are the sequential oracles: exact reimplementations of the reference
+semantics used (a) by the server between batched ticks, and (b) as the parity
+reference for the batched TPU kernels in `doorman_tpu.solver`.
+"""
+
+from doorman_tpu.algorithms.kinds import AlgoKind  # noqa: F401
+from doorman_tpu.algorithms.scalar import (  # noqa: F401
+    Request,
+    get_algorithm,
+    get_parameter,
+    learn,
+    no_algorithm,
+    proportional_share,
+    proportional_topup,
+    static,
+    fair_share,
+)

@@ -1,0 +1,20 @@
+"""Algorithm lane identifiers, shared by the host-side core and the device
+kernels. Kept free of jax imports so host-only paths (config validation,
+per-request serving) never pay the JAX startup cost."""
+
+from __future__ import annotations
+
+import enum
+
+
+class AlgoKind(enum.IntEnum):
+    """Per-resource algorithm lane. Values 0-3 match the wire enum
+    (doorman_tpu.proto Algorithm.Kind); the extra lanes are internal."""
+
+    NO_ALGORITHM = 0
+    STATIC = 1
+    PROPORTIONAL_SHARE = 2
+    FAIR_SHARE = 3
+    # The Go-style "equal share + proportional top-up" variant
+    # (reference algorithm.go:213-292) in snapshot form.
+    PROPORTIONAL_TOPUP = 4

@@ -1,0 +1,252 @@
+"""Per-request (incremental) allocation algorithms.
+
+Semantics parity with the reference algorithm suite
+(/root/reference/go/server/doorman/algorithm.go:66-313): each algorithm maps
+(lease store state, available capacity, one client's request) to a lease, and
+assigns it into the store — so the outcome for a client depends on the store
+state left behind by previously-processed requests. The batched solver
+(doorman_tpu.solver) recasts this as a per-tick snapshot solve; these scalar
+forms are the oracle for it and the fallback execution path for single
+requests arriving between ticks.
+
+An algorithm here is `fn(store, capacity, request) -> Lease`, produced by a
+factory taking the proto Algorithm config (lease_length / refresh_interval),
+mirroring the reference's closure design.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+from doorman_tpu.core.lease import Lease
+from doorman_tpu.core.store import LeaseStore
+from doorman_tpu.proto import doorman_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+Algorithm = Callable[[LeaseStore, float, "Request"], Lease]
+
+
+@dataclass(frozen=True)
+class Request:
+    """One client's capacity request for one resource."""
+
+    client: str
+    has: float
+    wants: float
+    subclients: int = 1
+
+
+def _params(config: pb.Algorithm) -> tuple[float, float]:
+    return float(config.lease_length), float(config.refresh_interval)
+
+
+def no_algorithm(config: pb.Algorithm) -> Algorithm:
+    """Every client gets exactly what it wants."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        return store.assign(r.client, length, interval, r.wants, r.wants, r.subclients)
+
+    return algo
+
+
+def static(config: pb.Algorithm) -> Algorithm:
+    """Every client gets min(configured capacity, wants); the configured
+    capacity is per client, not a shared total."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        return store.assign(
+            r.client, length, interval, min(capacity, r.wants), r.wants, r.subclients
+        )
+
+    return algo
+
+
+def learn(config: pb.Algorithm) -> Algorithm:
+    """Learning mode: grant whatever the client reports it already has, so a
+    freshly-elected master reconstructs state without overcommitting."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        return store.assign(r.client, length, interval, r.has, r.wants, r.subclients)
+
+    return algo
+
+
+def proportional_share(config: pb.Algorithm) -> Algorithm:
+    """Proportional share, simulation semantics (the framework's canonical
+    PROPORTIONAL_SHARE; parity target algo_proportional.py:31-65): grant
+    wants when total wants fit within capacity, otherwise scale every
+    client by capacity / all_wants; always clamped by the free capacity
+    (capacity minus leases outstanding to others)."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        old = store.get(r.client)
+        # The requester's own outstanding lease does not count against it.
+        all_wants = store.sum_wants - old.wants + r.wants
+        sum_leases = store.sum_has - old.has
+        free = max(capacity - sum_leases, 0.0)
+        if all_wants < capacity:
+            gets = min(r.wants, free)
+        else:
+            gets = min(r.wants * (capacity / all_wants), free)
+        return store.assign(r.client, length, interval, gets, r.wants, r.subclients)
+
+    return algo
+
+
+def proportional_topup(config: pb.Algorithm) -> Algorithm:
+    """Proportional share, Go reference semantics (algorithm.go:213-292;
+    select with algorithm parameter variant=topup): grant wants when there
+    is room; in overload, grant the equal share plus a top-up proportional
+    to the client's excess demand, funded by clients requesting under their
+    equal share."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        old = store.get(r.client)
+        count = store.count
+        if not store.has_client(r.client):
+            count += r.subclients
+
+        equal_share = capacity / count
+        equal_share_client = equal_share * r.subclients
+        # Capacity not currently promised to anyone else; the hard cap on
+        # what this run may grant.
+        unused = capacity - store.sum_has + old.has
+
+        if store.sum_wants <= capacity or r.wants <= equal_share_client:
+            return store.assign(
+                r.client, length, interval,
+                min(r.wants, unused), r.wants, r.subclients,
+            )
+
+        # Overload: pool the capacity left by clients under their equal
+        # share, and the excess demand of those over it, then top up
+        # proportionally.
+        extra_capacity = 0.0
+        extra_need = 0.0
+        for client_id, lease in store.items():
+            if client_id == r.client:
+                wants, subclients = r.wants, r.subclients
+            else:
+                wants, subclients = lease.wants, lease.subclients
+            share = equal_share * subclients
+            if wants < share:
+                extra_capacity += share - wants
+            else:
+                extra_need += wants - share
+
+        gets = equal_share_client + (r.wants - equal_share_client) * (
+            extra_capacity / extra_need
+        )
+        return store.assign(
+            r.client, length, interval, min(gets, unused), r.wants, r.subclients
+        )
+
+    return algo
+
+
+def fair_share(config: pb.Algorithm) -> Algorithm:
+    """Weighted fair share with two rounds of redistributing capacity left
+    unclaimed by clients under their equal share (the reference's bounded
+    approximation of max-min water-filling; the solver also offers the full
+    iterative water-fill — see doorman_tpu.solver.fairshare)."""
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        old = store.get(r.client)
+        if r.has != old.has:
+            log.error(
+                "client %s is confused: says it has %s, was assigned %s",
+                r.client, r.has, old.has,
+            )
+
+        count = store.count - old.subclients + r.subclients
+        available = capacity - store.sum_has + old.has
+        equal_share = capacity / count
+        deserved = equal_share * r.subclients
+
+        if r.wants <= deserved:
+            return store.assign(
+                r.client, length, interval,
+                min(r.wants, available), r.wants, r.subclients,
+            )
+
+        # Round 1: capacity left by clients under their equal share is
+        # contested by the subclients of everyone over it.
+        extra = 0.0
+        want_extra = r.subclients
+        want_extra_clients: Dict[str, Lease] = {}
+        for client_id, lease in store.items():
+            if client_id == r.client:
+                continue
+            their_deserved = lease.subclients * equal_share
+            if lease.wants < their_deserved:
+                extra += their_deserved - lease.wants
+            elif lease.wants > their_deserved:
+                want_extra += lease.subclients
+                want_extra_clients[client_id] = lease
+
+        deserved_extra = (extra / want_extra) * r.subclients
+        if r.wants < deserved + deserved_extra:
+            return store.assign(
+                r.client, length, interval,
+                min(r.wants, available), r.wants, r.subclients,
+            )
+
+        # Round 2: clients over their equal share but under share+extra
+        # leave part of the extra pool unclaimed; redistribute it once more.
+        want_extra_extra = r.subclients
+        extra_extra = 0.0
+        for client_id, lease in want_extra_clients.items():
+            if client_id == r.client:
+                continue
+            entitled = deserved_extra + deserved
+            if lease.wants < entitled:
+                extra_extra += entitled - lease.wants
+            elif lease.wants > entitled:
+                want_extra_extra += lease.subclients
+
+        deserved_extra_extra = (extra_extra / want_extra_extra) * r.subclients
+        return store.assign(
+            r.client, length, interval,
+            min(deserved + deserved_extra + deserved_extra_extra, available),
+            r.wants, r.subclients,
+        )
+
+    return algo
+
+
+def get_parameter(config: pb.Algorithm, name: str, default: str | None = None):
+    """Fetch a named algorithm parameter (analog of the simulation's
+    get_named_parameter, algorithm.py:66-71)."""
+    for p in config.parameters:
+        if p.name == name:
+            return p.value
+    return default
+
+
+_FACTORIES = {
+    pb.Algorithm.NO_ALGORITHM: no_algorithm,
+    pb.Algorithm.STATIC: static,
+    pb.Algorithm.PROPORTIONAL_SHARE: proportional_share,
+    pb.Algorithm.FAIR_SHARE: fair_share,
+}
+
+
+def get_algorithm(config: pb.Algorithm) -> Algorithm:
+    """Build the algorithm the config names (registry analog of
+    reference algorithm.go:304-313). PROPORTIONAL_SHARE with parameter
+    variant=topup selects the Go-style equal-share-plus-top-up form."""
+    if (
+        config.kind == pb.Algorithm.PROPORTIONAL_SHARE
+        and get_parameter(config, "variant") == "topup"
+    ):
+        return proportional_topup(config)
+    return _FACTORIES[config.kind](config)

@@ -1,0 +1,176 @@
+"""Per-tick (batch snapshot) allocation semantics — numpy oracles.
+
+The TPU solver recomputes every lease of a resource at once from a coherent
+snapshot taken at the start of a tick, instead of the reference's
+per-request incremental updates. These numpy implementations DEFINE that
+batch semantics; the JAX kernels in `doorman_tpu.solver` must match them
+bit-for-bit (given exactly-representable inputs), and tests relate them back
+to the reference's incremental semantics (they share fixed points).
+
+Semantics notes (decisions the reference leaves implicit, recorded here per
+SURVEY.md §7 "hard parts"):
+
+  * Proportional share follows the simulation form
+    (/root/reference/simulation/algo_proportional.py:31-65): in overload
+    every client is scaled by capacity / all_wants, clamped by the free
+    capacity. Two flavors:
+      - `proportional_snapshot`: free capacity for every client is computed
+        from the pre-tick grants (embarrassingly parallel; the headline
+        kernel semantics);
+      - `proportional_sequential`: exact replay of the simulation's
+        client-by-client order, where earlier grants in the tick shrink the
+        free capacity seen by later clients (the parity-oracle mode; the
+        solver implements it as a lax.scan lane).
+  * Fair share in batch form is FULL weighted max-min water-filling (the
+    ideal the reference documents in doc/algorithms.md:59-69); the Go code's
+    two-round redistribution (algorithm.go:95-211) is its truncation and is
+    kept only as the scalar per-request algorithm. In a whole-tick solve the
+    sum constraint is enforced exactly by the water level, so the per-client
+    "available" clamp of the incremental form is unnecessary.
+  * Static / None / Learn are pointwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "none_tick",
+    "static_tick",
+    "learn_tick",
+    "proportional_snapshot",
+    "proportional_sequential",
+    "proportional_topup_snapshot",
+    "fair_share_waterfill",
+    "waterfill_level",
+]
+
+
+def none_tick(wants: np.ndarray) -> np.ndarray:
+    return wants.copy()
+
+
+def static_tick(per_client_capacity: float, wants: np.ndarray) -> np.ndarray:
+    return np.minimum(per_client_capacity, wants)
+
+
+def learn_tick(has: np.ndarray) -> np.ndarray:
+    return has.copy()
+
+
+def proportional_snapshot(
+    capacity: float, wants: np.ndarray, has_prev: np.ndarray
+) -> np.ndarray:
+    """Proportional share for one resource, all clients from one snapshot.
+
+    `has_prev` are the grants outstanding from the previous tick; the free
+    capacity seen by client i excludes its own previous grant (the sim
+    clears the requester's `has` before summing leases).
+    """
+    all_wants = float(np.sum(wants))
+    sum_leases = float(np.sum(has_prev))
+    free = np.maximum(capacity - (sum_leases - has_prev), 0.0)
+    if all_wants < capacity:
+        return np.minimum(wants, free)
+    proportion = capacity / all_wants
+    return np.minimum(wants * proportion, free)
+
+
+def proportional_sequential(
+    capacity: float, wants: np.ndarray, has_prev: np.ndarray
+) -> np.ndarray:
+    """Exact replay of the simulation's per-client processing order within
+    one tick: client i's free capacity reflects the new grants of clients
+    0..i-1 and the previous grants of clients i+1.. ."""
+    n = wants.shape[0]
+    gets = np.zeros_like(wants)
+    all_wants = float(np.sum(wants))
+    sum_leases = float(np.sum(has_prev))  # running total of live leases
+    proportion = capacity / all_wants if all_wants >= capacity else None
+    for i in range(n):
+        free = max(capacity - (sum_leases - has_prev[i]), 0.0)
+        if proportion is None:
+            g = min(wants[i], free)
+        else:
+            g = min(wants[i] * proportion, free)
+        gets[i] = g
+        sum_leases += g - has_prev[i]
+    return gets
+
+
+def proportional_topup_snapshot(
+    capacity: float,
+    wants: np.ndarray,
+    has_prev: np.ndarray,
+    subclients: np.ndarray,
+) -> np.ndarray:
+    """Snapshot form of the Go proportional share (equal share plus a top-up
+    proportional to excess demand, reference algorithm.go:213-292): clients
+    under their equal share (or when total wants fit) get their wants;
+    otherwise equal_share_i + (wants_i - equal_share_i) * extra_capacity /
+    extra_need. Grants are clamped by the capacity unused as of the
+    snapshot. With all clients recomputed from one snapshot the reference's
+    request-order dependence disappears."""
+    wants = np.asarray(wants, dtype=np.float64)
+    has_prev = np.asarray(has_prev, dtype=np.float64)
+    sub = np.asarray(subclients, dtype=np.float64)
+    count = float(np.sum(sub))
+    sum_wants = float(np.sum(wants))
+    sum_has = float(np.sum(has_prev))
+    equal = (capacity / count) * sub
+    # Unlike the Go form this clamps at 0 (a store overcommitted by a
+    # previous learning phase must not produce negative grants); the sim's
+    # free-capacity rule does the same.
+    unused = np.maximum(capacity - (sum_has - has_prev), 0.0)
+    if sum_wants <= capacity:
+        return np.minimum(wants, unused)
+    under = wants < equal
+    extra_capacity = float(np.sum(np.where(under, equal - wants, 0.0)))
+    extra_need = float(np.sum(np.where(under, 0.0, wants - equal)))
+    topped = equal + (wants - equal) * (extra_capacity / extra_need)
+    return np.where(
+        wants <= equal, np.minimum(wants, unused), np.minimum(topped, unused)
+    )
+
+
+def waterfill_level(
+    capacity: float, wants: np.ndarray, weights: np.ndarray
+) -> float:
+    """Exact water level L for weighted max-min fairness: each client gets
+    min(wants_i, L * w_i) and the grants sum to `capacity` (assuming
+    sum(wants) >= capacity; otherwise returns max ratio so everyone is
+    satisfied). Computed by sorting the saturation ratios wants_i / w_i."""
+    w = np.asarray(weights, dtype=np.float64)
+    wants = np.asarray(wants, dtype=np.float64)
+    if float(np.sum(wants)) <= capacity:
+        ratios = np.where(w > 0, wants / np.maximum(w, 1e-300), 0.0)
+        return float(np.max(ratios, initial=0.0))
+    order = np.argsort(np.where(w > 0, wants / np.maximum(w, 1e-300), np.inf))
+    r = (wants / np.maximum(w, 1e-300))[order]
+    w_sorted = w[order]
+    wants_sorted = wants[order]
+    # After the first k clients saturate (get their wants), the rest share
+    # the remainder at level L = remaining / remaining_weight; L is valid
+    # when r[k-1] <= L <= r[k].
+    remaining = capacity
+    remaining_weight = float(np.sum(w_sorted))
+    for k in range(len(r)):
+        level = remaining / remaining_weight if remaining_weight > 0 else 0.0
+        if level <= r[k]:
+            return level
+        remaining -= wants_sorted[k]
+        remaining_weight -= w_sorted[k]
+    return remaining / remaining_weight if remaining_weight > 0 else 0.0
+
+
+def fair_share_waterfill(
+    capacity: float, wants: np.ndarray, subclients: np.ndarray
+) -> np.ndarray:
+    """Full weighted max-min fair share: if total wants fit, grant wants;
+    otherwise grant min(wants_i, L * subclients_i) at the exact water level."""
+    wants = np.asarray(wants, dtype=np.float64)
+    sub = np.asarray(subclients, dtype=np.float64)
+    if float(np.sum(wants)) <= capacity:
+        return wants.copy()
+    level = waterfill_level(capacity, wants, sub)
+    return np.minimum(wants, level * sub)

@@ -1,0 +1,4 @@
+"""Host-side core: leases, lease stores, resources, snapshots."""
+
+from doorman_tpu.core.lease import Lease, ZERO_LEASE  # noqa: F401
+from doorman_tpu.core.store import LeaseStore  # noqa: F401

@@ -1,0 +1,116 @@
+"""Resource: one rate-limited entity — config template + lease store +
+algorithm binding + learning-mode clock.
+
+Capability parity with /root/reference/go/server/doorman/resource.go:37-210.
+Python server handlers run on a single asyncio loop, so the reference's
+RWMutex discipline collapses away; the injected clock serves the simulation
+harness and tests.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from typing import Callable, Optional
+
+from doorman_tpu.algorithms import scalar
+from doorman_tpu.core.lease import Lease
+from doorman_tpu.core.store import LeaseStore
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.algorithms.kinds import AlgoKind
+
+
+def algo_kind_for(template: pb.ResourceTemplate) -> int:
+    """Map a config template to the solver lane. PROPORTIONAL_SHARE with
+    parameter variant=topup selects the Go-style top-up lane."""
+    kind = int(template.algorithm.kind)
+    if kind == int(pb.Algorithm.PROPORTIONAL_SHARE) and (
+        scalar.get_parameter(template.algorithm, "variant") == "topup"
+    ):
+        return int(AlgoKind.PROPORTIONAL_TOPUP)
+    return kind
+
+
+def static_param(template: pb.ResourceTemplate) -> float:
+    """STATIC's per-client capacity is the template capacity (the reference
+    reuses the capacity field with per-client meaning, algorithm.go:75-85)."""
+    return float(template.capacity)
+
+
+class Resource:
+    """A resource as the master sees it."""
+
+    def __init__(
+        self,
+        resource_id: str,
+        template: pb.ResourceTemplate,
+        *,
+        learning_mode_end: float = 0.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.id = resource_id
+        self._clock = clock
+        self.store = LeaseStore(resource_id, clock=clock)
+        self.learning_mode_end = learning_mode_end
+        # Expiry of the capacity lease this (intermediate) server holds from
+        # its parent; None on the root. Expired parent lease => capacity 0.
+        self.parent_expiry: Optional[float] = None
+        self.template: pb.ResourceTemplate = None  # set by load_config
+        self._algorithm: scalar.Algorithm = None
+        self._learner: scalar.Algorithm = None
+        self.load_config(template, None)
+
+    def load_config(
+        self, template: pb.ResourceTemplate, parent_expiry: Optional[float]
+    ) -> None:
+        self.template = template
+        self.parent_expiry = parent_expiry
+        self._algorithm = scalar.get_algorithm(template.algorithm)
+        self._learner = scalar.learn(template.algorithm)
+
+    @property
+    def capacity(self) -> float:
+        """Current capacity; zero when the parent lease has expired
+        (resource.go:62-72)."""
+        if self.parent_expiry is not None and self.parent_expiry < self._clock():
+            return 0.0
+        return self.template.capacity
+
+    @property
+    def in_learning_mode(self) -> bool:
+        return self.learning_mode_end > self._clock()
+
+    def decide(self, request: scalar.Request) -> Lease:
+        """Per-request (immediate-mode) decision: sweep expired leases then
+        run the configured algorithm — or the learner during learning mode
+        (resource.go:100-113)."""
+        self.store.clean()
+        if self.in_learning_mode:
+            return self._learner(self.store, self.capacity, request)
+        return self._algorithm(self.store, self.capacity, request)
+
+    def release(self, client: str) -> None:
+        self.store.release(client)
+
+    def matches(self, template: pb.ResourceTemplate) -> bool:
+        glob = template.identifier_glob
+        return glob == self.id or fnmatch.fnmatchcase(self.id, glob)
+
+    def safe_capacity(self) -> float:
+        """Configured safe capacity, or the dynamic fallback
+        capacity / known clients (resource.go:81-96)."""
+        if self.template.HasField("safe_capacity"):
+            return self.template.safe_capacity
+        count = max(self.store.count, 1)
+        return self.template.capacity / count
+
+    def status(self) -> dict:
+        return {
+            "id": self.id,
+            "sum_has": self.store.sum_has,
+            "sum_wants": self.store.sum_wants,
+            "count": self.store.count,
+            "capacity": self.capacity,
+            "in_learning_mode": self.in_learning_mode,
+            "algorithm": pb.Algorithm.Kind.Name(self.template.algorithm.kind),
+        }

@@ -1,0 +1,140 @@
+"""Snapshot packing: host lease stores -> padded device batches.
+
+The master mutates lease stores between ticks (requests arriving over gRPC);
+at each tick the whole (client x resource) table is snapshotted into an
+edge list, solved on device in one shot, and the resulting grants written
+back. Padding rounds the edge and resource counts up to size buckets
+(powers of two) so XLA compiles one executable per bucket, not per tick.
+
+This replaces the reference's per-resource goroutine fan-out
+(/root/reference/go/server/doorman/server.go:800-817) with a data-parallel
+batch; the snapshot boundary also gives the clean answer to the
+mid-tick-report hazard called out in SURVEY.md §7: requests that arrive
+while a solve is in flight mutate the NEXT snapshot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from doorman_tpu.solver.kernels import AlgoKind, EdgeBatch, ResourceBatch
+
+
+def _bucket(n: int, minimum: int = 64) -> int:
+    """Round up to the next power of two (>= minimum) to bound recompiles."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+@dataclass
+class ResourceSpec:
+    """Host-side description of one resource entering a tick."""
+
+    resource_id: str
+    capacity: float
+    algo_kind: int  # AlgoKind
+    learning: bool = False
+    static_capacity: float = 0.0
+
+
+@dataclass
+class Snapshot:
+    """A packed tick: device-ready batches plus the index maps needed to
+    scatter results back to (resource, client) pairs."""
+
+    edges: EdgeBatch
+    resources: ResourceBatch
+    # Parallel to the packed edge order:
+    edge_keys: List[Tuple[str, str]]  # (resource_id, client_id)
+    resource_ids: List[str]
+    num_edges: int
+
+    def unpack(self, gets: np.ndarray) -> Dict[Tuple[str, str], float]:
+        """Map a solved gets[E] array back to {(resource_id, client_id):
+        grant}."""
+        out = {}
+        arr = np.asarray(gets)
+        for i, key in enumerate(self.edge_keys):
+            out[key] = float(arr[i])
+        return out
+
+
+def pack_snapshot(
+    specs: Sequence[ResourceSpec],
+    rows: Callable[[str], Sequence[Tuple[str, float, float, int]]],
+    *,
+    dtype=np.float64,
+    edge_bucket_min: int = 64,
+    resource_bucket_min: int = 16,
+    to_device: Callable[[np.ndarray], object] | None = None,
+) -> Snapshot:
+    """Pack resources into a Snapshot.
+
+    `rows(resource_id)` yields (client_id, wants, has, subclients) tuples —
+    typically LeaseStore.items() adapted by the server. Edges are laid out
+    resource-major, so segment ids arrive sorted (the kernels rely on it).
+    """
+    edge_keys: List[Tuple[str, str]] = []
+    wants_l: List[float] = []
+    has_l: List[float] = []
+    sub_l: List[float] = []
+    rid_l: List[int] = []
+
+    resource_ids = [s.resource_id for s in specs]
+    for r, spec in enumerate(specs):
+        for client_id, wants, has, subclients in rows(spec.resource_id):
+            edge_keys.append((spec.resource_id, client_id))
+            rid_l.append(r)
+            wants_l.append(wants)
+            has_l.append(has)
+            sub_l.append(subclients)
+
+    E = _bucket(max(len(edge_keys), 1), edge_bucket_min)
+    R = _bucket(max(len(specs), 1), resource_bucket_min)
+
+    def fpad(xs: List[float], fill=0.0) -> np.ndarray:
+        arr = np.full(E, fill, dtype=dtype)
+        arr[: len(xs)] = xs
+        return arr
+
+    rid = np.full(E, R - 1, dtype=np.int32)
+    rid[: len(rid_l)] = rid_l
+    active = np.zeros(E, dtype=bool)
+    active[: len(edge_keys)] = True
+
+    cap = np.zeros(R, dtype=dtype)
+    kind = np.zeros(R, dtype=np.int32)
+    learning = np.zeros(R, dtype=bool)
+    static_cap = np.zeros(R, dtype=dtype)
+    for r, spec in enumerate(specs):
+        cap[r] = spec.capacity
+        kind[r] = int(spec.algo_kind)
+        learning[r] = spec.learning
+        static_cap[r] = spec.static_capacity
+
+    dev = to_device if to_device is not None else (lambda a: a)
+    edges = EdgeBatch(
+        resource=dev(rid),
+        wants=dev(fpad(wants_l)),
+        has=dev(fpad(has_l)),
+        subclients=dev(fpad(sub_l)),
+        active=dev(active),
+    )
+    resources = ResourceBatch(
+        capacity=dev(cap),
+        algo_kind=dev(kind),
+        learning=dev(learning),
+        static_capacity=dev(static_cap),
+    )
+    return Snapshot(
+        edges=edges,
+        resources=resources,
+        edge_keys=edge_keys,
+        resource_ids=resource_ids,
+        num_edges=len(edge_keys),
+    )

@@ -1,0 +1,131 @@
+"""Per-resource lease store with O(1) running aggregates.
+
+Capability parity with the reference store
+(/root/reference/go/server/doorman/store.go:68-213): client -> lease map with
+running sum_has / sum_wants / subclient count, expiry sweep, and a read-only
+status view. Differences by design:
+
+  - the clock is injected (defaults to time.time) so the simulation harness
+    and tests can run on virtual time;
+  - iteration order over clients is insertion order (Python dict), which is
+    deterministic — the Go map iteration is randomized. The batch solver
+    relies on this determinism for reproducible packing.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Tuple
+
+from doorman_tpu.core.lease import Lease, ZERO_LEASE
+
+
+@dataclass
+class ClientLeaseStatus:
+    client_id: str
+    lease: Lease
+
+
+@dataclass
+class ResourceLeaseStatus:
+    id: str
+    sum_has: float
+    sum_wants: float
+    leases: List[ClientLeaseStatus] = field(default_factory=list)
+
+
+class LeaseStore:
+    """The set of outstanding leases for one resource."""
+
+    def __init__(self, id: str, clock: Callable[[], float] = time.time):
+        self.id = id
+        self._clock = clock
+        self._leases: Dict[str, Lease] = {}
+        self._sum_wants = 0.0
+        self._sum_has = 0.0
+        self._count = 0  # total subclients
+
+    def __len__(self) -> int:
+        return len(self._leases)
+
+    @property
+    def count(self) -> int:
+        """Total number of subclients across all leases."""
+        return self._count
+
+    @property
+    def sum_has(self) -> float:
+        return self._sum_has
+
+    @property
+    def sum_wants(self) -> float:
+        return self._sum_wants
+
+    def get(self, client: str) -> Lease:
+        return self._leases.get(client, ZERO_LEASE)
+
+    def has_client(self, client: str) -> bool:
+        return client in self._leases
+
+    def subclients(self, client: str) -> int:
+        return self._leases.get(client, ZERO_LEASE).subclients
+
+    def assign(
+        self,
+        client: str,
+        lease_length: float,
+        refresh_interval: float,
+        has: float,
+        wants: float,
+        subclients: int,
+    ) -> Lease:
+        """Record capacity `has` given to `client`; updates running sums by
+        delta and stamps a fresh expiry of now + lease_length."""
+        old = self._leases.get(client, ZERO_LEASE)
+        self._sum_has += has - old.has
+        self._sum_wants += wants - old.wants
+        self._count += subclients - old.subclients
+        lease = Lease(
+            expiry=self._clock() + lease_length,
+            refresh_interval=refresh_interval,
+            has=has,
+            wants=wants,
+            subclients=subclients,
+        )
+        self._leases[client] = lease
+        return lease
+
+    def release(self, client: str) -> None:
+        lease = self._leases.pop(client, None)
+        if lease is None:
+            return
+        self._sum_wants -= lease.wants
+        self._sum_has -= lease.has
+        self._count -= lease.subclients
+
+    def clean(self) -> int:
+        """Remove expired leases; returns how many were removed."""
+        now = self._clock()
+        expired = [c for c, l in self._leases.items() if now > l.expiry]
+        for client in expired:
+            self.release(client)
+        return len(expired)
+
+    def items(self) -> Iterator[Tuple[str, Lease]]:
+        return iter(self._leases.items())
+
+    def map(self, fn: Callable[[str, Lease], None]) -> None:
+        for client, lease in self._leases.items():
+            fn(client, lease)
+
+    def lease_status(self) -> ResourceLeaseStatus:
+        return ResourceLeaseStatus(
+            id=self.id,
+            sum_has=self._sum_has,
+            sum_wants=self._sum_wants,
+            leases=[
+                ClientLeaseStatus(client_id=c, lease=l)
+                for c, l in self._leases.items()
+            ],
+        )

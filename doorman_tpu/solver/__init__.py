@@ -1,0 +1,17 @@
+"""Batched allocation solver: the TPU-native replacement for the reference's
+per-request algorithm loop.
+
+One refresh tick = one `solve_tick` call: the master's (client x resource)
+wants table, flattened to an edge list, is solved for ALL resources at once
+on device. Algorithm choice is a per-resource lane selected by `algo_kind`,
+so a single compiled executable covers every configured algorithm.
+"""
+
+from doorman_tpu.solver.kernels import (  # noqa: F401
+    AlgoKind,
+    EdgeBatch,
+    ResourceBatch,
+    solve_tick,
+    solve_tick_jit,
+)
+from doorman_tpu.solver.fairshare import waterfill_levels  # noqa: F401

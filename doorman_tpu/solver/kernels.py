@@ -1,0 +1,194 @@
+"""The batched per-tick allocation kernel.
+
+Data model: the (client x resource) wants table is sparse — a client holds
+leases on few resources — so the device representation is an edge list
+("edge" = one client's lease on one resource), segmented by resource id:
+
+    EdgeBatch:    wants/has/subclients/resource-id/active per edge   [E]
+    ResourceBatch: capacity, algo_kind, learning flag, static cap    [R]
+
+One `solve_tick` computes new grants for every edge in one XLA executable:
+segment-sums produce the per-resource aggregates, every algorithm is
+evaluated as a vectorized lane over all edges, and `algo_kind` selects the
+lane per resource. This replaces the reference's per-request O(clients)
+loop (/root/reference/go/server/doorman/server.go:800-817 fanning out to
+algorithm.go) with a single data-parallel solve; semantics are the batch
+snapshot semantics defined by the numpy oracles in
+doorman_tpu.algorithms.tick.
+
+Shapes are static: E and R are padded (see doorman_tpu.core.snapshot) so
+XLA compiles once per bucket size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.solver.fairshare import waterfill_levels
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class EdgeBatch:
+    """One edge per (client, resource) lease relationship. Edges must be
+    sorted by resource id (the packer guarantees it); `active` masks padding.
+    """
+
+    resource: jax.Array  # int32 [E]
+    wants: jax.Array  # float [E]
+    has: jax.Array  # float [E] — grants outstanding from the previous tick
+    subclients: jax.Array  # float [E]
+    active: jax.Array  # bool [E]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ResourceBatch:
+    """Per-resource configuration, padded to R."""
+
+    capacity: jax.Array  # float [R]
+    algo_kind: jax.Array  # int32 [R], AlgoKind values
+    learning: jax.Array  # bool [R] — resource in learning mode: grant = has
+    static_capacity: jax.Array  # float [R] — per-client cap for STATIC lane
+
+    @property
+    def num_resources(self) -> int:
+        return self.capacity.shape[0]
+
+
+def _seg(values, ids, num_segments):
+    return jax.ops.segment_sum(
+        values, ids, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
+def solve_tick(edges: EdgeBatch, resources: ResourceBatch) -> jax.Array:
+    """Compute new grants for every edge. Returns gets [E] (padding lanes
+    produce 0)."""
+    R = resources.num_resources
+    dtype = edges.wants.dtype
+    zero = jnp.zeros((), dtype)
+    rid = edges.resource
+
+    wants = jnp.where(edges.active, edges.wants, zero)
+    has = jnp.where(edges.active, edges.has, zero)
+    sub = jnp.where(edges.active, edges.subclients, zero)
+
+    sum_wants = _seg(wants, rid, R)  # [R]
+    sum_has = _seg(has, rid, R)  # [R]
+    count = _seg(sub, rid, R)  # [R]
+
+    cap_r = resources.capacity
+    cap_e = cap_r[rid]
+
+    # ---- Lane: NO_ALGORITHM — everyone gets what they want.
+    gets_none = wants
+
+    # ---- Lane: STATIC — per-client configured cap.
+    gets_static = jnp.minimum(resources.static_capacity[rid], wants)
+
+    # ---- Lane: LEARN — replay the client's self-reported grant.
+    gets_learn = has
+
+    # ---- Lane: PROPORTIONAL_SHARE (simulation semantics,
+    # algo_proportional.py:31-65): pure scaling by capacity / all_wants in
+    # overload, clamped by the free capacity as seen from the snapshot
+    # (own previous grant excluded from the outstanding-lease sum).
+    free = jnp.maximum(cap_e - (sum_has[rid] - has), zero)
+    underloaded_e = (sum_wants < cap_r)[rid]
+    safe_sum_wants = jnp.maximum(sum_wants[rid], jnp.finfo(dtype).tiny)
+    scaled = wants * (cap_e / safe_sum_wants)
+    gets_prop = jnp.where(
+        underloaded_e, jnp.minimum(wants, free), jnp.minimum(scaled, free)
+    )
+
+    # ---- Lane: PROPORTIONAL_TOPUP (Go semantics, snapshot form):
+    # equal share + top-up funded by clients under their equal share.
+    safe_count = jnp.maximum(count[rid], jnp.finfo(dtype).tiny)
+    equal = (cap_e / safe_count) * sub
+    under = wants < equal
+    extra_capacity = _seg(jnp.where(under, equal - wants, zero), rid, R)[rid]
+    extra_need = _seg(jnp.where(under, zero, wants - equal), rid, R)[rid]
+    topped = equal + (wants - equal) * (
+        extra_capacity / jnp.maximum(extra_need, jnp.finfo(dtype).tiny)
+    )
+    fits = (sum_wants <= cap_r)[rid]
+    gets_topup = jnp.where(
+        fits | (wants <= equal),
+        jnp.minimum(wants, free),
+        jnp.minimum(topped, free),
+    )
+
+    # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
+    level = waterfill_levels(
+        cap_r, wants, sub, rid, edges.active, num_resources=R
+    )
+    fair_fits = (sum_wants <= cap_r)[rid]
+    gets_fair = jnp.where(fair_fits, wants, jnp.minimum(wants, level[rid] * sub))
+
+    kind_e = resources.algo_kind[rid]
+    gets = jnp.select(
+        [
+            kind_e == AlgoKind.NO_ALGORITHM,
+            kind_e == AlgoKind.STATIC,
+            kind_e == AlgoKind.PROPORTIONAL_SHARE,
+            kind_e == AlgoKind.FAIR_SHARE,
+            kind_e == AlgoKind.PROPORTIONAL_TOPUP,
+        ],
+        [gets_none, gets_static, gets_prop, gets_fair, gets_topup],
+        default=zero,
+    )
+
+    # Learning-mode resources replay reported grants regardless of lane
+    # (reference resource.go:108-111).
+    gets = jnp.where(resources.learning[rid], gets_learn, gets)
+    return jnp.where(edges.active, gets, zero)
+
+
+solve_tick_jit = jax.jit(solve_tick)
+
+
+# ---------------------------------------------------------------------------
+# Dense sequential-replay lane (parity oracle on device).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=())
+def proportional_sequential_dense(
+    capacity: jax.Array,  # [R]
+    wants: jax.Array,  # [R, C]
+    has_prev: jax.Array,  # [R, C]
+    active: jax.Array,  # [R, C] bool
+) -> jax.Array:
+    """Exact replay of the simulation's client-processing order inside a
+    tick (doorman_tpu.algorithms.tick.proportional_sequential), as a
+    lax.scan over the client axis vmapped over resources. Quadratic-free but
+    sequential in C — used for parity validation, not the headline path."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    w = jnp.where(active, wants, zero)
+    h = jnp.where(active, has_prev, zero)
+    all_wants = jnp.sum(w, axis=1)  # [R]
+    overloaded = all_wants >= capacity
+    proportion = jnp.where(
+        overloaded, capacity / jnp.maximum(all_wants, jnp.finfo(dtype).tiny), 1.0
+    )
+
+    def per_resource(cap, over, prop, w_row, h_row, a_row):
+        def step(sum_leases, inp):
+            wi, hi, ai = inp
+            free = jnp.maximum(cap - (sum_leases - hi), zero)
+            g = jnp.minimum(jnp.where(over, wi * prop, wi), free)
+            g = jnp.where(ai, g, zero)
+            return sum_leases + g - hi, g
+
+        init = jnp.sum(h_row)
+        _, gets_row = jax.lax.scan(step, init, (w_row, h_row, a_row))
+        return gets_row
+
+    return jax.vmap(per_resource)(capacity, overloaded, proportion, w, h, active)

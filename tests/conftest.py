@@ -1,14 +1,21 @@
 """Test configuration: force an 8-device virtual CPU platform so the
 multi-chip sharding paths (shard_map over a Mesh) are exercised without TPU
-hardware. Must run before jax is imported anywhere."""
+hardware, and enable f64 for the parity math.
+
+Note: a pytest plugin imports jax before this conftest runs, so the env-var
+route is too late for jax.config defaults — but the XLA backend itself is
+not initialized until first use, so jax.config.update and XLA_FLAGS still
+take effect here."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# The solver does its parity math in float64.
-os.environ.setdefault("JAX_ENABLE_X64", "True")
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

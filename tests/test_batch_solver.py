@@ -1,0 +1,134 @@
+"""BatchSolver integration: stores -> snapshot -> device solve -> write-back,
+and equivalence with the per-request path at the protocol's fixed points."""
+
+import numpy as np
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.algorithms import Request
+from doorman_tpu.core.resource import Resource
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.solver.batch import BatchSolver
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def template(kind=pb.Algorithm.PROPORTIONAL_SHARE, capacity=120.0,
+             lease=60, refresh=16, glob="*"):
+    return pb.ResourceTemplate(
+        identifier_glob=glob,
+        capacity=capacity,
+        algorithm=pb.Algorithm(
+            kind=kind, lease_length=lease, refresh_interval=refresh
+        ),
+    )
+
+
+def test_tick_solves_and_writes_back():
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    # Three clients report wants; initial grants via immediate path.
+    for c, w in [("a", 60.0), ("b", 60.0), ("c", 10.0)]:
+        res.store.assign(c, 60, 16, 0.0, w, 1)
+
+    solver = BatchSolver(clock=clock)
+    grants = solver.tick([res])
+
+    # Overload: proportional scaling 120/130, clamped by free capacity.
+    g = grants["r0"]
+    assert abs(sum(g.values()) - 120.0) < 1e-9 or sum(g.values()) <= 120.0
+    np.testing.assert_allclose(
+        [g["a"], g["b"], g["c"]],
+        np.array([60.0, 60.0, 10.0]) * (120.0 / 130.0),
+    )
+    # Write-back updated the store and stamped fresh expiries.
+    assert res.store.get("a").has == g["a"]
+    assert res.store.get("a").expiry == clock() + 60
+
+
+def test_tick_is_fixed_point_of_immediate_path():
+    # After a batched tick, running the scalar per-request algorithm for any
+    # single client must not change its grant (steady state equivalence).
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    rng = np.random.default_rng(0)
+    wants = rng.integers(1, 100, 20).astype(float)
+    for i, w in enumerate(wants):
+        res.store.assign(f"c{i}", 60, 16, 0.0, float(w), 1)
+
+    solver = BatchSolver(clock=clock)
+    solver.tick([res])
+    solver.tick([res])  # second tick: free capacity now reflects grants
+
+    before = {c: res.store.get(c).has for c in [f"c{i}" for i in range(20)]}
+    for i in range(20):
+        c = f"c{i}"
+        lease = res.decide(Request(c, before[c], float(wants[i]), 1))
+        assert abs(lease.has - before[c]) < 1e-6, (c, lease.has, before[c])
+
+
+def test_learning_mode_replays_has():
+    clock = FakeClock()
+    res = Resource(
+        "r0", template(), learning_mode_end=clock() + 100, clock=clock
+    )
+    res.store.assign("a", 60, 16, 33.0, 50.0, 1)
+    solver = BatchSolver(clock=clock)
+    grants = solver.tick([res])
+    assert grants["r0"]["a"] == 33.0
+
+
+def test_expired_leases_swept_before_solve():
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    res.store.assign("old", 5, 1, 10.0, 10.0, 1)
+    clock.advance(10)
+    res.store.assign("new", 60, 16, 0.0, 10.0, 1)
+    solver = BatchSolver(clock=clock)
+    grants = solver.tick([res])
+    assert "old" not in grants["r0"]
+    assert grants["r0"]["new"] == 10.0
+
+
+def test_multiple_resources_mixed_kinds():
+    clock = FakeClock()
+    r_prop = Resource("prop", template(), clock=clock)
+    r_fair = Resource(
+        "fair", template(kind=pb.Algorithm.FAIR_SHARE), clock=clock
+    )
+    r_none = Resource(
+        "none", template(kind=pb.Algorithm.NO_ALGORITHM), clock=clock
+    )
+    for r in (r_prop, r_fair, r_none):
+        for c, w in [("a", 100.0), ("b", 40.0)]:
+            r.store.assign(c, 60, 16, 0.0, w, 1)
+    solver = BatchSolver(clock=clock)
+    grants = solver.tick([r_prop, r_fair, r_none])
+    # none: everyone gets wants
+    assert grants["none"] == {"a": 100.0, "b": 40.0}
+    # fair: waterfill of 120 => a gets 80, b gets 40
+    assert grants["fair"] == {"a": 80.0, "b": 40.0}
+    # prop: scaled by 120/140
+    np.testing.assert_allclose(
+        [grants["prop"]["a"], grants["prop"]["b"]],
+        [100.0 * 120.0 / 140.0, 40.0 * 120.0 / 140.0],
+    )
+
+
+def test_parent_expiry_zeroes_capacity():
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    res.load_config(template(), parent_expiry=clock() - 1)
+    res.store.assign("a", 60, 16, 0.0, 50.0, 1)
+    solver = BatchSolver(clock=clock)
+    grants = solver.tick([res])
+    assert grants["r0"]["a"] == 0.0

@@ -1,0 +1,252 @@
+"""Parity tests: the batched JAX solve must reproduce the numpy tick
+oracles exactly (inputs are integer-valued f64, so every sum/division in
+both implementations is computed on identical representable values)."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (env vars before jax import)
+import jax.numpy as jnp
+
+from doorman_tpu.algorithms import tick
+from doorman_tpu.solver import AlgoKind, EdgeBatch, ResourceBatch, solve_tick
+from doorman_tpu.solver.kernels import proportional_sequential_dense
+
+
+def build_batch(tables, *, pad_edges=0, pad_resources=0, dtype=np.float64):
+    """tables: list of dicts with capacity, kind, wants[], has[], sub[],
+    optional static_cap, learning."""
+    rid, wants, has, sub = [], [], [], []
+    for r, t in enumerate(tables):
+        for i in range(len(t["wants"])):
+            rid.append(r)
+            wants.append(t["wants"][i])
+            has.append(t.get("has", [0.0] * len(t["wants"]))[i])
+            sub.append(t.get("sub", [1.0] * len(t["wants"]))[i])
+    E = len(rid) + pad_edges
+    R = len(tables) + pad_resources
+    active = np.zeros(E, dtype=bool)
+    active[: len(rid)] = True
+    pad = lambda xs, fill: np.array(
+        list(xs) + [fill] * (E - len(xs)), dtype=dtype
+    )
+    edges = EdgeBatch(
+        resource=jnp.array(
+            np.array(rid + [R - 1] * pad_edges, dtype=np.int32)
+        ),
+        wants=jnp.array(pad(wants, 0.0)),
+        has=jnp.array(pad(has, 0.0)),
+        subclients=jnp.array(pad(sub, 0.0)),
+        active=jnp.array(active),
+    )
+    rpad = lambda xs, fill: np.array(
+        list(xs) + [fill] * (R - len(xs)), dtype=dtype
+    )
+    resources = ResourceBatch(
+        capacity=jnp.array(rpad([t["capacity"] for t in tables], 0.0)),
+        algo_kind=jnp.array(
+            np.array(
+                [int(t["kind"]) for t in tables] + [0] * pad_resources,
+                dtype=np.int32,
+            )
+        ),
+        learning=jnp.array(
+            np.array(
+                [t.get("learning", False) for t in tables]
+                + [False] * pad_resources
+            )
+        ),
+        static_capacity=jnp.array(
+            rpad([t.get("static_cap", 0.0) for t in tables], 0.0)
+        ),
+    )
+    return edges, resources
+
+
+def oracle_for(t):
+    wants = np.array(t["wants"], dtype=np.float64)
+    has = np.array(t.get("has", [0.0] * len(wants)), dtype=np.float64)
+    sub = np.array(t.get("sub", [1.0] * len(wants)), dtype=np.float64)
+    if t.get("learning"):
+        return tick.learn_tick(has)
+    kind = t["kind"]
+    if kind == AlgoKind.NO_ALGORITHM:
+        return tick.none_tick(wants)
+    if kind == AlgoKind.STATIC:
+        return tick.static_tick(t["static_cap"], wants)
+    if kind == AlgoKind.PROPORTIONAL_SHARE:
+        return tick.proportional_snapshot(t["capacity"], wants, has)
+    if kind == AlgoKind.PROPORTIONAL_TOPUP:
+        return tick.proportional_topup_snapshot(t["capacity"], wants, has, sub)
+    if kind == AlgoKind.FAIR_SHARE:
+        return tick.fair_share_waterfill(t["capacity"], wants, sub)
+    raise ValueError(kind)
+
+
+def check_tables(tables, **kw):
+    edges, resources = build_batch(tables, **kw)
+    gets = np.asarray(solve_tick(edges, resources))
+    i = 0
+    for r, t in enumerate(tables):
+        n = len(t["wants"])
+        expected = oracle_for(t)
+        np.testing.assert_array_equal(
+            gets[i : i + n],
+            expected,
+            err_msg=f"resource {r} (kind={t['kind']})",
+        )
+        i += n
+    # padding produced zeros
+    assert np.all(gets[i:] == 0.0)
+
+
+def test_single_resource_each_kind():
+    base = {"wants": [60.0, 60.0, 10.0], "capacity": 120.0}
+    check_tables([{**base, "kind": AlgoKind.NO_ALGORITHM}])
+    check_tables([{**base, "kind": AlgoKind.STATIC, "static_cap": 50.0}])
+    check_tables([{**base, "kind": AlgoKind.PROPORTIONAL_SHARE}])
+    check_tables([{**base, "kind": AlgoKind.PROPORTIONAL_TOPUP}])
+    check_tables([{**base, "kind": AlgoKind.FAIR_SHARE}])
+
+
+def test_go_reference_tables_topup():
+    # algorithm_test.go TestProportionalShare / WithMultipleSubclients
+    # (preloaded): [55, 55, 10] and [60, 40, 20].
+    edges, resources = build_batch(
+        [
+            {
+                "kind": AlgoKind.PROPORTIONAL_TOPUP,
+                "capacity": 120.0,
+                "wants": [60.0, 60.0, 10.0],
+            },
+            {
+                "kind": AlgoKind.PROPORTIONAL_TOPUP,
+                "capacity": 120.0,
+                "wants": [65.0, 45.0, 20.0],
+                "sub": [3.0, 2.0, 1.0],
+            },
+        ]
+    )
+    gets = np.asarray(solve_tick(edges, resources))
+    np.testing.assert_allclose(gets[:3], [55.0, 55.0, 10.0])
+    np.testing.assert_allclose(gets[3:6], [60.0, 40.0, 20.0])
+
+
+def test_go_reference_tables_fairshare():
+    tables = [
+        {"kind": AlgoKind.FAIR_SHARE, "capacity": 120.0, "wants": [1000.0, 60.0, 10.0]},
+        {"kind": AlgoKind.FAIR_SHARE, "capacity": 120.0, "wants": [1000.0, 50.0, 10.0]},
+        {
+            "kind": AlgoKind.FAIR_SHARE,
+            "capacity": 120.0,
+            "wants": [1000.0, 500.0, 200.0],
+            "sub": [6.0, 4.0, 2.0],
+        },
+        {
+            "kind": AlgoKind.FAIR_SHARE,
+            "capacity": 1000.0,
+            "wants": [2000.0, 500.0, 700.0],
+            "sub": [10.0, 10.0, 30.0],
+        },
+    ]
+    edges, resources = build_batch(tables)
+    gets = np.asarray(solve_tick(edges, resources))
+    np.testing.assert_allclose(gets[0:3], [55.0, 55.0, 10.0])
+    np.testing.assert_allclose(gets[3:6], [60.0, 50.0, 10.0])
+    np.testing.assert_allclose(gets[6:9], [60.0, 40.0, 20.0])
+    np.testing.assert_allclose(gets[9:12], [200.0, 200.0, 600.0])
+
+
+def test_learning_mode_overrides_lane():
+    check_tables(
+        [
+            {
+                "kind": AlgoKind.FAIR_SHARE,
+                "capacity": 10.0,
+                "wants": [100.0, 200.0],
+                "has": [7.0, 3.0],
+                "learning": True,
+            }
+        ]
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_randomized_mixed_batch_bit_parity(seed):
+    rng = np.random.default_rng(seed)
+    kinds = [
+        AlgoKind.NO_ALGORITHM,
+        AlgoKind.STATIC,
+        AlgoKind.PROPORTIONAL_SHARE,
+        AlgoKind.PROPORTIONAL_TOPUP,
+        AlgoKind.FAIR_SHARE,
+    ]
+    tables = []
+    for _ in range(30):
+        n = int(rng.integers(1, 25))
+        tables.append(
+            {
+                "kind": kinds[int(rng.integers(len(kinds)))],
+                "capacity": float(rng.integers(1, 500)),
+                "static_cap": float(rng.integers(1, 100)),
+                "wants": rng.integers(0, 200, n).astype(np.float64).tolist(),
+                "has": rng.integers(0, 100, n).astype(np.float64).tolist(),
+                "sub": rng.integers(1, 8, n).astype(np.float64).tolist(),
+                "learning": bool(rng.integers(0, 10) == 0),
+            }
+        )
+    check_tables(tables, pad_edges=17, pad_resources=3)
+
+
+def test_property_never_overcommit_fair_and_prop():
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        n = int(rng.integers(2, 50))
+        for kind in (AlgoKind.PROPORTIONAL_SHARE, AlgoKind.FAIR_SHARE):
+            t = {
+                "kind": kind,
+                "capacity": float(rng.integers(10, 300)),
+                "wants": rng.integers(0, 100, n).astype(np.float64).tolist(),
+                # steady state: has from a previous solve, never overcommitted
+                "has": [0.0] * n,
+            }
+            edges, resources = build_batch([t])
+            gets = np.asarray(solve_tick(edges, resources))
+            assert gets.sum() <= t["capacity"] + 1e-9 or (
+                np.sum(t["wants"]) <= t["capacity"]
+            )
+
+
+def test_equal_share_floor_fairshare():
+    # Overloaded fair share: every client asking >= equal share gets >= the
+    # equal share (the floor guarantee the reference documents).
+    n, cap = 10, 100.0
+    wants = (np.ones(n) * 50.0).tolist()
+    edges, resources = build_batch(
+        [{"kind": AlgoKind.FAIR_SHARE, "capacity": cap, "wants": wants}]
+    )
+    gets = np.asarray(solve_tick(edges, resources))
+    np.testing.assert_allclose(gets[:n], cap / n)
+
+
+def test_sequential_dense_matches_numpy():
+    rng = np.random.default_rng(3)
+    R, C = 6, 40
+    wants = rng.integers(0, 100, (R, C)).astype(np.float64)
+    has = rng.integers(0, 50, (R, C)).astype(np.float64)
+    active = rng.random((R, C)) < 0.9
+    wants *= active
+    has *= active
+    cap = rng.integers(50, 2000, R).astype(np.float64)
+    gets = np.asarray(
+        proportional_sequential_dense(
+            jnp.array(cap), jnp.array(wants), jnp.array(has), jnp.array(active)
+        )
+    )
+    for r in range(R):
+        idx = np.where(active[r])[0]
+        expected = tick.proportional_sequential(
+            cap[r], wants[r, idx], has[r, idx]
+        )
+        np.testing.assert_array_equal(gets[r, idx], expected, err_msg=f"r={r}")
+        assert np.all(gets[r, ~active[r]] == 0.0)

@@ -1,0 +1,79 @@
+"""Lease store tests (capability parity with reference store_test.go, but on
+an injected virtual clock instead of real 10s sleeps)."""
+
+from doorman_tpu.core import LeaseStore
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_assign_updates_sums():
+    s = LeaseStore("r")
+    s.assign("a", 300, 5, has=10, wants=20, subclients=1)
+    s.assign("b", 300, 5, has=5, wants=7, subclients=2)
+    assert s.sum_has == 15
+    assert s.sum_wants == 27
+    assert s.count == 3
+    assert len(s) == 2
+
+
+def test_reassign_applies_delta():
+    s = LeaseStore("r")
+    s.assign("a", 300, 5, has=10, wants=20, subclients=1)
+    s.assign("a", 300, 5, has=4, wants=6, subclients=3)
+    assert s.sum_has == 4
+    assert s.sum_wants == 6
+    assert s.count == 3
+    assert len(s) == 1
+
+
+def test_release():
+    s = LeaseStore("r")
+    s.assign("a", 300, 5, has=10, wants=20, subclients=1)
+    s.assign("b", 300, 5, has=1, wants=2, subclients=1)
+    s.release("a")
+    assert s.sum_has == 1
+    assert s.sum_wants == 2
+    assert s.count == 1
+    assert not s.has_client("a")
+    s.release("missing")  # no-op
+    assert s.count == 1
+
+
+def test_get_missing_is_zero_lease():
+    s = LeaseStore("r")
+    lease = s.get("nope")
+    assert lease.is_zero
+    assert lease.has == 0.0
+    assert s.subclients("nope") == 0
+
+
+def test_clean_expired():
+    clock = FakeClock()
+    s = LeaseStore("r", clock=clock)
+    s.assign("short", lease_length=5, refresh_interval=1, has=1, wants=1, subclients=1)
+    s.assign("long", lease_length=50, refresh_interval=1, has=2, wants=2, subclients=1)
+    clock.advance(10)
+    assert s.clean() == 1
+    assert not s.has_client("short")
+    assert s.has_client("long")
+    assert s.sum_has == 2
+
+
+def test_lease_status_snapshot():
+    s = LeaseStore("r")
+    s.assign("a", 300, 5, has=10, wants=20, subclients=1)
+    st = s.lease_status()
+    assert st.id == "r"
+    assert st.sum_has == 10
+    assert st.sum_wants == 20
+    assert len(st.leases) == 1
+    assert st.leases[0].client_id == "a"

@@ -1,0 +1,157 @@
+"""Tests for the per-tick (batch snapshot) numpy oracles that define the
+solver semantics, and their relationship to the reference's incremental
+algorithms."""
+
+import numpy as np
+import pytest
+
+from doorman_tpu.algorithms import tick
+
+
+class TestProportionalSnapshot:
+    def test_underload_grants_wants(self):
+        wants = np.array([10.0, 20.0, 30.0])
+        has = np.zeros(3)
+        gets = tick.proportional_snapshot(100.0, wants, has)
+        np.testing.assert_array_equal(gets, wants)
+
+    def test_overload_scales_proportionally(self):
+        # Matches simulation/algo_proportional.py: proportion = cap/all_wants.
+        wants = np.array([60.0, 60.0, 80.0])
+        has = np.zeros(3)
+        gets = tick.proportional_snapshot(100.0, wants, has)
+        np.testing.assert_allclose(gets, wants * (100.0 / 200.0))
+        assert gets.sum() <= 100.0 + 1e-12
+
+    def test_free_capacity_clamps(self):
+        # Other clients hold the whole capacity from the previous tick; a
+        # newcomer is clamped by the free capacity (0 here).
+        wants = np.array([50.0, 50.0, 50.0])
+        has = np.array([50.0, 50.0, 0.0])
+        gets = tick.proportional_snapshot(100.0, wants, has)
+        assert gets[2] == 0.0
+
+    def test_self_has_excluded_from_leases(self):
+        # A single client holding everything can still be re-granted: its own
+        # previous lease does not count against its free capacity.
+        wants = np.array([80.0])
+        has = np.array([100.0])
+        gets = tick.proportional_snapshot(100.0, wants, has)
+        assert gets[0] == 80.0
+
+
+class TestProportionalSequential:
+    def test_matches_snapshot_on_steady_state(self):
+        # At a fixed point (has == the snapshot solution, all free) the
+        # sequential replay returns the same grants.
+        rng = np.random.default_rng(0)
+        wants = rng.integers(1, 100, 50).astype(np.float64)
+        has = tick.proportional_snapshot(800.0, wants, np.zeros(50))
+        seq = tick.proportional_sequential(800.0, wants, has)
+        snap = tick.proportional_snapshot(800.0, wants, has)
+        np.testing.assert_allclose(seq, snap)
+
+    def test_order_dependence_matches_reference_story(self):
+        # Fresh store, overload: early clients squeeze the late one, exactly
+        # like the unpreloaded reference table.
+        wants = np.array([60.0, 75.0, 10.0])
+        has = np.zeros(3)
+        gets = tick.proportional_sequential(145.0, wants, has)
+        # all_wants = 145 >= cap: everyone scaled by 145/145 = 1, then
+        # clamped by evolving free capacity.
+        assert gets[0] == 60.0
+        assert gets[1] == 75.0
+        assert gets[2] == 10.0
+
+    def test_never_overcommits(self):
+        rng = np.random.default_rng(1)
+        for _ in range(20):
+            n = rng.integers(1, 30)
+            wants = rng.integers(0, 100, n).astype(np.float64)
+            has = rng.integers(0, 50, n).astype(np.float64)
+            cap = float(rng.integers(1, 200))
+            gets = tick.proportional_sequential(cap, wants, has)
+            assert np.sum(gets) <= cap + 1e-9
+
+
+class TestProportionalTopup:
+    def test_matches_go_table_preloaded(self):
+        # Reference algorithm_test.go TestProportionalShare, preloaded store:
+        # equal share 40, extra capacity 30 from c2, extra need 40.
+        wants = np.array([60.0, 60.0, 10.0])
+        has = np.zeros(3)
+        sub = np.ones(3)
+        gets = tick.proportional_topup_snapshot(120.0, wants, has, sub)
+        np.testing.assert_allclose(gets, [55.0, 55.0, 10.0])
+
+    def test_matches_go_table_subclients(self):
+        wants = np.array([65.0, 45.0, 20.0])
+        has = np.zeros(3)
+        sub = np.array([3.0, 2.0, 1.0])
+        gets = tick.proportional_topup_snapshot(120.0, wants, has, sub)
+        np.testing.assert_allclose(gets, [60.0, 40.0, 20.0])
+
+    def test_underload(self):
+        wants = np.array([5.0, 10.0])
+        gets = tick.proportional_topup_snapshot(
+            100.0, wants, np.zeros(2), np.ones(2)
+        )
+        np.testing.assert_array_equal(gets, wants)
+
+
+class TestFairShareWaterfill:
+    # The same tables as the reference's FairShare tests: full water-filling
+    # agrees with the two-round approximation on all of them.
+    @pytest.mark.parametrize(
+        "wants,sub,cap,expected",
+        [
+            ([1000, 60, 10], [1, 1, 1], 120, [55, 55, 10]),
+            ([1000, 50, 10], [1, 1, 1], 120, [60, 50, 10]),
+            ([1000, 500, 200], [6, 4, 2], 120, [60, 40, 20]),
+            ([2000, 500, 700], [10, 10, 30], 1000, [200, 200, 600]),
+        ],
+    )
+    def test_reference_tables(self, wants, sub, cap, expected):
+        gets = tick.fair_share_waterfill(
+            float(cap), np.array(wants, dtype=np.float64), np.array(sub, dtype=np.float64)
+        )
+        np.testing.assert_allclose(gets, expected)
+
+    def test_sums_to_capacity_in_overload(self):
+        rng = np.random.default_rng(2)
+        for _ in range(50):
+            n = int(rng.integers(1, 40))
+            wants = rng.integers(0, 1000, n).astype(np.float64)
+            sub = rng.integers(1, 10, n).astype(np.float64)
+            cap = float(rng.integers(1, 500))
+            gets = tick.fair_share_waterfill(cap, wants, sub)
+            if wants.sum() <= cap:
+                np.testing.assert_array_equal(gets, wants)
+            else:
+                assert abs(gets.sum() - cap) < 1e-6
+            # max-min property: nobody below their saturated fair level
+            # unless fully satisfied.
+            assert np.all(gets <= wants + 1e-12)
+
+    def test_equal_share_floor(self):
+        # In overload, a client wanting at least its equal share never gets
+        # less than the water level * weight >= equal share of capacity.
+        wants = np.array([100.0, 100.0, 100.0, 1.0])
+        sub = np.ones(4)
+        cap = 40.0
+        gets = tick.fair_share_waterfill(cap, wants, sub)
+        level = tick.waterfill_level(cap, wants, sub)
+        assert level >= cap / 4 - 1e-12
+        np.testing.assert_allclose(gets[:3], level)
+        assert gets[3] == 1.0
+
+
+class TestPointwise:
+    def test_none_static_learn(self):
+        wants = np.array([5.0, 500.0])
+        has = np.array([1.0, 2.0])
+        np.testing.assert_array_equal(tick.none_tick(wants), wants)
+        np.testing.assert_array_equal(
+            tick.static_tick(100.0, wants), [5.0, 100.0]
+        )
+        np.testing.assert_array_equal(tick.learn_tick(has), has)
