@@ -1,0 +1,5 @@
+"""Client side: master-aware connection + background-refresh capacity
+client."""
+
+from doorman_tpu.client.client import Client, ClientResource  # noqa: F401
+from doorman_tpu.client.connection import Connection  # noqa: F401

@@ -1,0 +1,230 @@
+"""Background-refresh capacity client.
+
+Capability parity with reference go/client/doorman/client.go: the client
+holds a set of claimed resources, refreshes all their leases in one bulk
+GetCapacity on the shortest refresh interval (floored by
+minimum_refresh_interval), pushes capacity changes to per-resource queues
+(bounded, dropping when full — slow consumers see the latest values on
+their next read), zeroes capacity when a lease expires during an outage,
+and releases capacity on close.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import time
+from typing import Dict, Optional
+
+from doorman_tpu.client.connection import Connection
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
+
+log = logging.getLogger(__name__)
+
+CAPACITY_QUEUE_SIZE = 32
+
+_id_counter = 0
+
+
+def _default_client_id() -> str:
+    global _id_counter
+    _id_counter += 1
+    return f"{socket.gethostname()}:{os.getpid()}:{_id_counter}"
+
+
+class ErrInvalidWants(ValueError):
+    pass
+
+
+class ErrDuplicateResourceID(ValueError):
+    pass
+
+
+class ClientResource:
+    """A resource claimed through a Client. Capacity updates arrive on
+    `capacity()`; `ask()` changes the desired capacity; `release()` drops
+    the lease."""
+
+    def __init__(self, client: "Client", resource_id: str, wants: float,
+                 priority: int):
+        self._client = client
+        self.id = resource_id
+        self.priority = priority
+        self.wants = wants
+        self.lease: Optional[pb.Lease] = None
+        self._capacity: asyncio.Queue[float] = asyncio.Queue(
+            maxsize=CAPACITY_QUEUE_SIZE
+        )
+
+    def capacity(self) -> "asyncio.Queue[float]":
+        return self._capacity
+
+    def current_capacity(self) -> float:
+        return self.lease.capacity if self.lease is not None else 0.0
+
+    def expires(self) -> float:
+        return self.lease.expiry_time if self.lease is not None else 0.0
+
+    async def ask(self, wants: float) -> None:
+        if wants <= 0:
+            raise ErrInvalidWants(wants)
+        self.wants = wants
+
+    async def release(self) -> None:
+        await self._client.release_resource(self)
+
+    def _push_capacity(self, value: float) -> None:
+        try:
+            self._capacity.put_nowait(value)
+        except asyncio.QueueFull:
+            pass  # consumer lags; it will see newer values later
+
+
+class Client:
+    """A doorman-tpu client. Create with `await Client.connect(addr)`."""
+
+    def __init__(
+        self,
+        addr: str,
+        client_id: Optional[str] = None,
+        *,
+        minimum_refresh_interval: float = 5.0,
+    ):
+        self.id = client_id or _default_client_id()
+        self.conn = Connection(
+            addr, minimum_refresh_interval=minimum_refresh_interval
+        )
+        self.resources: Dict[str, ClientResource] = {}
+        self._wake = asyncio.Event()
+        self._closed = False
+        self._task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def connect(cls, addr: str, client_id: Optional[str] = None,
+                      **kwargs) -> "Client":
+        client = cls(addr, client_id, **kwargs)
+        client._task = asyncio.create_task(client._run())
+        return client
+
+    def master(self) -> str:
+        return str(self.conn)
+
+    async def resource(
+        self, resource_id: str, wants: float, priority: int = 0
+    ) -> ClientResource:
+        """Claim a resource; the first refresh happens immediately."""
+        if resource_id in self.resources:
+            raise ErrDuplicateResourceID(resource_id)
+        res = ClientResource(self, resource_id, wants, priority)
+        self.resources[resource_id] = res
+        self._wake.set()
+        return res
+
+    async def release_resource(self, res: ClientResource) -> None:
+        if self.resources.pop(res.id, None) is None:
+            return
+        try:
+            await self.conn.execute(
+                lambda stub: stub.ReleaseCapacity(
+                    pb.ReleaseCapacityRequest(
+                        client_id=self.id, resource_id=[res.id]
+                    )
+                )
+            )
+        except Exception:
+            log.exception("%s: ReleaseCapacity failed", self.id)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self.resources:
+            try:
+                await self.conn.execute(
+                    lambda stub: stub.ReleaseCapacity(
+                        pb.ReleaseCapacityRequest(
+                            client_id=self.id,
+                            resource_id=list(self.resources),
+                        )
+                    )
+                )
+            except Exception:
+                log.exception("%s: ReleaseCapacity on close failed", self.id)
+        await self.conn.close()
+
+    # ------------------------------------------------------------------
+
+    async def _run(self) -> None:
+        """Main loop: wake on a new resource or when the shortest refresh
+        interval elapses; refresh everything in one bulk RPC
+        (client.go:227-294)."""
+        interval, retry = 0.0, 0
+        while not self._closed:
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if not self.resources:
+                interval = VERY_LONG_TIME
+                continue
+            interval, retry = await self._perform_requests(retry)
+
+    async def _perform_requests(self, retry_number: int):
+        request = pb.GetCapacityRequest(client_id=self.id)
+        for resource_id, res in self.resources.items():
+            rr = request.resource.add()
+            rr.resource_id = resource_id
+            rr.priority = res.priority
+            rr.wants = res.wants
+            if res.lease is not None:
+                rr.has.CopyFrom(res.lease)
+
+        try:
+            out = await self.conn.execute(
+                lambda stub: stub.GetCapacity(request),
+            )
+        except Exception:
+            log.exception("%s: GetCapacity failed", self.id)
+            now = time.time()
+            for res in self.resources.values():
+                if res.lease is not None and res.expires() < now:
+                    # Lease expired during the outage: the application must
+                    # fall back (to safe capacity; 0 here, matching the
+                    # reference's choice at client.go:359-366).
+                    res.lease = None
+                    res._push_capacity(0.0)
+            return (
+                backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number),
+                retry_number + 1,
+            )
+
+        for pr in out.response:
+            res = self.resources.get(pr.resource_id)
+            if res is None:
+                log.error(
+                    "%s: response for unclaimed resource %r",
+                    self.id, pr.resource_id,
+                )
+                continue
+            old_capacity = (
+                res.lease.capacity if res.lease is not None else -1.0
+            )
+            res.lease = pb.Lease()
+            res.lease.CopyFrom(pr.gets)
+            if res.lease.capacity != old_capacity:
+                res._push_capacity(res.lease.capacity)
+
+        interval = VERY_LONG_TIME
+        for res in self.resources.values():
+            if res.lease is not None:
+                interval = min(interval, float(res.lease.refresh_interval))
+        interval = max(interval, self.conn.minimum_refresh_interval)
+        return interval, 0

@@ -1,0 +1,123 @@
+"""Master-aware gRPC connection.
+
+Capability parity with reference go/connection/connection.go:128-227: an RPC
+is retried with exponential backoff on transport errors; a response carrying
+a `mastership` field means "not the master" — reconnect to the indicated
+master (immediately) or retry after backoff when the master is unknown.
+Shared by the client library and by intermediate servers talking to their
+parent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional, TypeVar
+
+import grpc
+
+from doorman_tpu.proto.grpc_api import CapacityStub
+from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, backoff
+
+log = logging.getLogger(__name__)
+
+
+T = TypeVar("T")
+
+
+class Connection:
+    """A channel to "the current master", starting from a seed address."""
+
+    def __init__(
+        self,
+        addr: str,
+        *,
+        minimum_refresh_interval: float = 5.0,
+        max_retries: Optional[int] = None,
+        grpc_options: Optional[list] = None,
+    ):
+        self.addr = addr
+        self.current_master = ""
+        self.minimum_refresh_interval = minimum_refresh_interval
+        self.max_retries = max_retries
+        self._grpc_options = grpc_options
+        self._channel: Optional[grpc.aio.Channel] = None
+        self.stub: Optional[CapacityStub] = None
+
+    def __str__(self) -> str:
+        return self.current_master
+
+    async def _connect(self, addr: str) -> None:
+        await self.close()
+        log.info("connecting to %s", addr)
+        self._channel = grpc.aio.insecure_channel(
+            addr, options=self._grpc_options
+        )
+        self.stub = CapacityStub(self._channel)
+        self.current_master = addr
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self.stub = None
+            self.current_master = ""
+
+    async def execute(
+        self, call: Callable[[CapacityStub], Awaitable[T]]
+    ) -> T:
+        """Run `call` against the current master, following mastership
+        redirects and backing off on errors. Raises the last error once
+        max_retries is exhausted (the reference retries forever; pass
+        max_retries=None for that behavior)."""
+        retries = 0
+        last_error: Optional[Exception] = None
+        while self.max_retries is None or retries <= self.max_retries:
+            if retries > 0:
+                await asyncio.sleep(backoff(MIN_BACKOFF, MAX_BACKOFF, retries))
+            retries += 1
+
+            sleepless_redirects = 0
+            while True:
+                if self._channel is None:
+                    try:
+                        await self._connect(self.addr)
+                    except Exception as e:  # dial errors retry with backoff
+                        last_error = e
+                        break
+                try:
+                    out = await call(self.stub)
+                except Exception as e:
+                    last_error = e
+                    await self.close()
+                    break
+
+                if not out.HasField("mastership"):
+                    # The server processed the request: it is the master.
+                    return out
+
+                mastership = out.mastership
+                if not mastership.HasField("master_address") or (
+                    mastership.master_address == ""
+                ):
+                    log.warning(
+                        "%s is not the master and does not know who is",
+                        self.current_master,
+                    )
+                    last_error = MasterUnknown(self.current_master)
+                    break
+
+                # Redirect: reconnect to the indicated master and retry
+                # immediately (bounded, in case two servers point at each
+                # other).
+                sleepless_redirects += 1
+                if sleepless_redirects > 5:
+                    last_error = MasterUnknown(mastership.master_address)
+                    break
+                await self._connect(mastership.master_address)
+
+        raise last_error if last_error is not None else MasterUnknown(self.addr)
+
+
+class MasterUnknown(ConnectionError):
+    """No master is currently known/reachable."""

@@ -1,0 +1,7 @@
+"""Rate limiters that convert capacity leases into admission control."""
+
+from doorman_tpu.ratelimiter.qps import QPSRateLimiter, new_qps  # noqa: F401
+from doorman_tpu.ratelimiter.adaptive import (  # noqa: F401
+    AdaptiveQPSRateLimiter,
+    new_adaptive_qps,
+)

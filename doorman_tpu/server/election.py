@@ -1,0 +1,226 @@
+"""Master election.
+
+Capability parity with reference go/server/election/election.go:29-172:
+an Election is something a server runs; it reports mastership changes and
+the identity of the current master. Two implementations:
+
+  * TrivialElection — the participant wins immediately (single-server
+    deployments, tests).
+  * KVElection — the reference's etcd flow (TTL'd lock key: acquire with
+    set-if-absent, renew every ttl/3, watch broadcasts the holder)
+    generalized over an abstract LeaseKV so the failover state machine is
+    testable without an etcd cluster. EtcdKV speaks the etcd v2 HTTP API
+    when an etcd endpoint is actually available; InMemoryKV backs tests and
+    multi-server single-process setups.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+
+IsMasterCallback = Callable[[bool], Awaitable[None]]
+CurrentMasterCallback = Callable[[str], Awaitable[None]]
+
+
+class Election(abc.ABC):
+    """A master election. `run` starts campaigning and returns immediately;
+    outcomes are delivered through the callbacks (mirrors the reference's
+    IsMaster()/Current() channels)."""
+
+    @abc.abstractmethod
+    async def run(
+        self,
+        id: str,
+        on_is_master: IsMasterCallback,
+        on_current: CurrentMasterCallback,
+    ) -> None:
+        ...
+
+    async def stop(self) -> None:
+        pass
+
+
+class TrivialElection(Election):
+    """The participant immediately wins (reference election.go:51-73)."""
+
+    def __str__(self) -> str:
+        return "no election, acting as the master"
+
+    async def run(self, id, on_is_master, on_current) -> None:
+        await on_is_master(True)
+        await on_current(id)
+
+
+class LeaseKV(abc.ABC):
+    """A tiny TTL'd-key store: just enough of etcd for the election."""
+
+    @abc.abstractmethod
+    async def acquire(self, key: str, value: str, ttl: float) -> bool:
+        """Set key=value with ttl iff the key does not exist (or has
+        expired). Returns True on success."""
+
+    @abc.abstractmethod
+    async def refresh(self, key: str, value: str, ttl: float) -> bool:
+        """Extend the ttl iff the key still holds `value`."""
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> Optional[str]:
+        """Current live value of the key, or None."""
+
+
+class InMemoryKV(LeaseKV):
+    """Process-local LeaseKV for tests and single-process multi-server
+    topologies. Supports fault injection via `expire`."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._data: Dict[str, Tuple[str, float]] = {}
+
+    def _live(self, key: str) -> Optional[str]:
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        value, expiry = entry
+        if expiry <= self._clock():
+            del self._data[key]
+            return None
+        return value
+
+    async def acquire(self, key, value, ttl) -> bool:
+        if self._live(key) is not None:
+            return False
+        self._data[key] = (value, self._clock() + ttl)
+        return True
+
+    async def refresh(self, key, value, ttl) -> bool:
+        if self._live(key) != value:
+            return False
+        self._data[key] = (value, self._clock() + ttl)
+        return True
+
+    async def get(self, key) -> Optional[str]:
+        return self._live(key)
+
+    def expire(self, key: str) -> None:
+        """Fault injection: drop the lock as if its TTL lapsed."""
+        self._data.pop(key, None)
+
+
+class EtcdKV(LeaseKV):
+    """etcd v2 HTTP API LeaseKV (reference election.go:112-171 uses the v2
+    client). Blocking HTTP is pushed to the default executor; this is a
+    control-plane path where latency tolerance is seconds."""
+
+    def __init__(self, endpoints: list[str]):
+        if not endpoints:
+            raise ValueError("EtcdKV needs at least one endpoint")
+        self._endpoints = [e.rstrip("/") for e in endpoints]
+
+    async def _request(
+        self, method: str, key: str, params: Optional[dict] = None
+    ) -> Optional[dict]:
+        def call() -> Optional[dict]:
+            for endpoint in self._endpoints:
+                url = f"{endpoint}/v2/keys{key}"
+                data = None
+                if params is not None:
+                    data = urllib.parse.urlencode(params).encode()
+                req = urllib.request.Request(url, data=data, method=method)
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as resp:
+                        return json.load(resp)
+                except urllib.error.HTTPError as e:
+                    try:
+                        return json.load(e)
+                    except Exception:
+                        return None
+                except OSError:
+                    continue
+            return None
+
+        return await asyncio.get_running_loop().run_in_executor(None, call)
+
+    async def acquire(self, key, value, ttl) -> bool:
+        out = await self._request(
+            "PUT", key,
+            {"value": value, "ttl": int(ttl), "prevExist": "false"},
+        )
+        return bool(out) and "errorCode" not in out
+
+    async def refresh(self, key, value, ttl) -> bool:
+        out = await self._request(
+            "PUT", key,
+            {
+                "value": value,
+                "ttl": int(ttl),
+                "prevExist": "true",
+                "prevValue": value,
+            },
+        )
+        return bool(out) and "errorCode" not in out
+
+    async def get(self, key) -> Optional[str]:
+        out = await self._request("GET", key)
+        if not out or "errorCode" in out:
+            return None
+        return out.get("node", {}).get("value")
+
+
+class KVElection(Election):
+    """TTL-lock election over a LeaseKV (reference election.go:89-172):
+    campaign with acquire, renew every ttl/3, report loss when a renewal
+    fails; a watcher polls the key and broadcasts the current master."""
+
+    def __init__(self, kv: LeaseKV, lock: str, ttl: float = 10.0):
+        self._kv = kv
+        self._lock = lock
+        self._ttl = ttl
+        self._tasks: list[asyncio.Task] = []
+
+    def __str__(self) -> str:
+        return f"kv lock: {self._lock} (ttl {self._ttl}s)"
+
+    async def run(self, id, on_is_master, on_current) -> None:
+        self._tasks.append(
+            asyncio.create_task(self._campaign(id, on_is_master))
+        )
+        self._tasks.append(asyncio.create_task(self._watch(on_current)))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+
+    async def _campaign(self, id: str, on_is_master: IsMasterCallback) -> None:
+        while True:
+            if not await self._kv.acquire(self._lock, id, self._ttl):
+                await asyncio.sleep(self._ttl)
+                continue
+            await on_is_master(True)
+            while True:
+                await asyncio.sleep(self._ttl / 3)
+                if not await self._kv.refresh(self._lock, id, self._ttl):
+                    await on_is_master(False)
+                    break
+
+    async def _watch(self, on_current: CurrentMasterCallback) -> None:
+        last: Optional[str] = None
+        while True:
+            current = await self._kv.get(self._lock)
+            value = current or ""
+            if value != last:
+                last = value
+                await on_current(value)
+            await asyncio.sleep(min(1.0, self._ttl / 3))

@@ -1,0 +1,498 @@
+"""The capacity server.
+
+Capability parity with reference go/server/doorman/server.go: the four
+Capacity RPCs with mastership redirects, glob-templated hot-reloadable
+config, learning mode after mastership changes, the intermediate-server role
+(lease capacity from a parent and re-template it locally), and status views
+for the debug pages / metrics.
+
+TPU-native redesign: instead of running an algorithm per request
+(server.go:800-817), the server can run in batch mode — requests only
+record demand, and a background tick solves ALL resources at once on device
+through doorman_tpu.solver.BatchSolver. The per-request scalar path remains
+for brand-new clients (first response) and as `mode="immediate"`, which is
+exactly the reference's request-order semantics.
+
+Concurrency model: one asyncio loop owns all state (no locks); the batched
+solve runs in an executor thread between snapshot boundaries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Dict, List, Optional
+
+import grpc
+
+from doorman_tpu.algorithms import Request
+from doorman_tpu.core.resource import Resource
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
+from doorman_tpu.server import config as config_mod
+from doorman_tpu.server.election import Election
+from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PRIORITY = 1
+# Matching reference defaults (server.go:42-90).
+DEFAULT_INTERVAL = 1.0
+
+
+def default_resource_template() -> pb.ResourceTemplate:
+    """The "*" template an intermediate server starts from
+    (server.go:53-63)."""
+    return pb.ResourceTemplate(
+        identifier_glob="*",
+        capacity=0.0,
+        safe_capacity=0.0,
+        algorithm=pb.Algorithm(
+            kind=pb.Algorithm.FAIR_SHARE,
+            refresh_interval=int(DEFAULT_INTERVAL),
+            lease_length=20,
+            learning_mode_duration=20,
+        ),
+    )
+
+
+class CapacityServer(CapacityServicer):
+    """A doorman-tpu server: root if parent_addr is empty, else
+    intermediate."""
+
+    def __init__(
+        self,
+        server_id: str,
+        election: Election,
+        *,
+        parent_addr: str = "",
+        mode: str = "immediate",  # "immediate" | "batch"
+        tick_interval: float = 1.0,
+        minimum_refresh_interval: float = 5.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        if mode not in ("immediate", "batch"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.id = server_id
+        self.election = election
+        self.mode = mode
+        self.tick_interval = tick_interval
+        self.minimum_refresh_interval = minimum_refresh_interval
+        self._clock = clock
+
+        self.resources: Dict[str, Resource] = {}
+        self.is_master = False
+        self.became_master_at: float = 0.0
+        self.current_master = ""
+        self.config: Optional[pb.ResourceRepository] = None
+        self.is_configured = asyncio.Event()
+
+        self.parent_addr = parent_addr
+        self._parent_conn = None  # created lazily (import cycle + testing)
+        self._tasks: List[asyncio.Task] = []
+        self._solver = None
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self.port: Optional[int] = None
+
+        # Metrics hooks; the metrics module replaces these when enabled.
+        self.on_request: Callable[[str, float, bool], None] = lambda *a: None
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, port: int = 0, host: str = "[::]") -> int:
+        """Start serving gRPC; returns the bound port."""
+        server = grpc.aio.server()
+        add_capacity_servicer(server, self)
+        self.port = server.add_insecure_port(f"{host}:{port}")
+        await server.start()
+        self._grpc_server = server
+
+        if self.parent_addr:
+            # Intermediate servers self-configure from parent grants
+            # (server.go:575-586) and keep refreshing them.
+            await self.load_config(
+                pb.ResourceRepository(resources=[default_resource_template()]),
+                {},
+            )
+            self._tasks.append(asyncio.create_task(self._updater_loop()))
+
+        if self.mode == "batch":
+            self._tasks.append(asyncio.create_task(self._tick_loop()))
+        return self.port
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        await self.election.stop()
+        if self._parent_conn is not None:
+            await self._parent_conn.close()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=None)
+            self._grpc_server = None
+
+    async def wait_until_configured(self) -> None:
+        await self.is_configured.wait()
+
+    # ------------------------------------------------------------------
+    # Config and election
+    # ------------------------------------------------------------------
+
+    async def load_config(
+        self,
+        repo: pb.ResourceRepository,
+        expiry_times: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """Install a new ResourceRepository (validating it); the first load
+        also enters the election (server.go:187-218)."""
+        config_mod.validate_repository(repo)
+        first_time = self.config is None
+        self.config = repo
+        if first_time:
+            self.is_configured.set()
+            await self.election.run(
+                self.id, self._on_is_master, self._on_current_master
+            )
+            return
+        expiry_times = expiry_times or {}
+        for resource_id, res in self.resources.items():
+            res.load_config(
+                config_mod.find_template(repo, resource_id),
+                expiry_times.get(resource_id),
+            )
+
+    async def _on_is_master(self, is_master: bool) -> None:
+        """Mastership changes wipe all lease state; a fresh master starts in
+        learning mode (server.go:438-455)."""
+        self.is_master = is_master
+        if is_master:
+            log.info("%s: this server is now the master", self.id)
+            self.became_master_at = self._clock()
+            self.resources = {}
+        else:
+            log.warning("%s: this server lost mastership", self.id)
+            self.became_master_at = 0.0
+            self.resources = {}
+
+    async def _on_current_master(self, master: str) -> None:
+        if master != self.current_master:
+            log.info("%s: current master is now %r", self.id, master)
+            self.current_master = master
+
+    def learning_mode_end(self, duration: float) -> float:
+        """When a resource with the given learning-mode duration leaves
+        learning mode (server.go:172-181)."""
+        if duration <= 0:
+            return 0.0
+        return self.became_master_at + duration
+
+    # ------------------------------------------------------------------
+    # Resource registry
+    # ------------------------------------------------------------------
+
+    def get_or_create_resource(self, resource_id: str) -> Resource:
+        res = self.resources.get(resource_id)
+        if res is not None:
+            return res
+        template = config_mod.find_template(self.config, resource_id)
+        algo = template.algorithm
+        if algo.HasField("learning_mode_duration"):
+            duration = float(algo.learning_mode_duration)
+        else:
+            duration = float(algo.lease_length)
+        res = Resource(
+            resource_id,
+            template,
+            learning_mode_end=self.learning_mode_end(duration),
+            clock=self._clock,
+        )
+        self.resources[resource_id] = res
+        return res
+
+    # ------------------------------------------------------------------
+    # Batch tick loop (the TPU path)
+    # ------------------------------------------------------------------
+
+    def _get_solver(self):
+        if self._solver is None:
+            import jax
+
+            if not jax.config.jax_enable_x64:
+                # The batch solver's f64 parity contract needs x64; the
+                # server owns the process, so enabling it here is safe.
+                log.info("%s: enabling jax_enable_x64 for the batch solver",
+                         self.id)
+                jax.config.update("jax_enable_x64", True)
+            from doorman_tpu.solver.batch import BatchSolver
+
+            self._solver = BatchSolver(clock=self._clock)
+        return self._solver
+
+    async def tick_once(self) -> None:
+        """Run one batched solve over all resources. Snapshot packing and
+        grant write-back run on the event loop (atomic w.r.t. RPC
+        handlers); only the device solve itself runs in the executor."""
+        if not self.resources:
+            return
+        solver = self._get_solver()
+        resources = list(self.resources.values())
+        snap = solver.prepare(resources)
+        loop = asyncio.get_running_loop()
+        gets = await loop.run_in_executor(None, solver.solve, snap)
+        solver.apply(resources, snap, gets)
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_interval)
+            if not self.is_master:
+                continue
+            try:
+                await self.tick_once()
+            except Exception:
+                log.exception("%s: batched tick failed", self.id)
+
+    # ------------------------------------------------------------------
+    # RPC handlers
+    # ------------------------------------------------------------------
+
+    def _mastership(self) -> pb.Mastership:
+        m = pb.Mastership()
+        if self.current_master:
+            m.master_address = self.current_master
+        return m
+
+    async def Discovery(self, request, context):
+        out = pb.DiscoveryResponse(is_master=self.is_master)
+        out.mastership.CopyFrom(self._mastership())
+        return out
+
+    async def GetCapacity(self, request, context):
+        start = self._clock()
+        out = pb.GetCapacityResponse()
+        err = False
+        try:
+            if not self.is_master:
+                out.mastership.CopyFrom(self._mastership())
+                return out
+            msg = config_mod.validate_get_capacity_request(request)
+            if msg is not None:
+                err = True
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+            for req in request.resource:
+                has = req.has.capacity if req.HasField("has") else 0.0
+                lease, res = self._decide(
+                    req.resource_id,
+                    Request(request.client_id, has, req.wants, 1),
+                )
+                resp = out.response.add()
+                resp.resource_id = req.resource_id
+                resp.gets.expiry_time = int(lease.expiry)
+                resp.gets.refresh_interval = int(lease.refresh_interval)
+                resp.gets.capacity = lease.has
+                resp.safe_capacity = res.safe_capacity()
+            return out
+        finally:
+            self.on_request("GetCapacity", self._clock() - start, err)
+
+    async def GetServerCapacity(self, request, context):
+        start = self._clock()
+        out = pb.GetServerCapacityResponse()
+        err = False
+        try:
+            if not self.is_master:
+                out.mastership.CopyFrom(self._mastership())
+                return out
+            msg = config_mod.validate_get_server_capacity_request(request)
+            if msg is not None:
+                err = True
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+            for req in request.resource:
+                wants_total = sum(band.wants for band in req.wants)
+                subclients_total = sum(band.num_clients for band in req.wants)
+                has = req.has.capacity if req.HasField("has") else 0.0
+                lease, res = self._decide(
+                    req.resource_id,
+                    Request(
+                        request.server_id, has, wants_total,
+                        max(subclients_total, 1),
+                    ),
+                )
+                resp = out.response.add()
+                resp.resource_id = req.resource_id
+                resp.gets.expiry_time = int(lease.expiry)
+                resp.gets.refresh_interval = int(lease.refresh_interval)
+                resp.gets.capacity = lease.has
+                resp.algorithm.CopyFrom(res.template.algorithm)
+                resp.safe_capacity = (
+                    res.template.safe_capacity
+                    if res.template.HasField("safe_capacity")
+                    else 0.0
+                )
+            return out
+        finally:
+            self.on_request("GetServerCapacity", self._clock() - start, err)
+
+    async def ReleaseCapacity(self, request, context):
+        start = self._clock()
+        out = pb.ReleaseCapacityResponse()
+        try:
+            if not self.is_master:
+                out.mastership.CopyFrom(self._mastership())
+                return out
+            for resource_id in request.resource_id:
+                res = self.resources.get(resource_id)
+                if res is not None:
+                    res.release(request.client_id)
+            return out
+        finally:
+            self.on_request("ReleaseCapacity", self._clock() - start, False)
+
+    def _decide(self, resource_id: str, request: Request):
+        """Produce a lease for one resource request. Immediate mode (and
+        unknown clients, and learning mode) run the scalar per-request
+        algorithm; batch mode serves the last tick's solved grant and only
+        records the new demand."""
+        res = self.get_or_create_resource(resource_id)
+        if (
+            self.mode == "batch"
+            and not res.in_learning_mode
+            and self._solver is not None
+            and self._solver.ticks > 0
+            and res.store.has_client(request.client)
+        ):
+            algo = res.template.algorithm
+            lease = res.store.assign(
+                request.client,
+                float(algo.lease_length),
+                float(algo.refresh_interval),
+                res.store.get(request.client).has,
+                request.wants,
+                request.subclients,
+            )
+            return lease, res
+        return res.decide(request), res
+
+    # ------------------------------------------------------------------
+    # Intermediate-server updater (refresh capacity from parent)
+    # ------------------------------------------------------------------
+
+    def _build_server_capacity_request(self) -> pb.GetServerCapacityRequest:
+        """Aggregate every local resource into a single-band request
+        (server.go:227-261)."""
+        out = pb.GetServerCapacityRequest(server_id=self.id)
+        for resource_id, res in self.resources.items():
+            if res.store.sum_wants > 0:
+                rr = out.resource.add()
+                rr.resource_id = resource_id
+                band = rr.wants.add()
+                band.priority = DEFAULT_PRIORITY
+                band.num_clients = max(res.store.count, 1)
+                band.wants = res.store.sum_wants
+        if not out.resource:
+            # Probe request so the parent link stays warm (server.go:66-79).
+            rr = out.resource.add()
+            rr.resource_id = "*"
+            band = rr.wants.add()
+            band.priority = DEFAULT_PRIORITY
+            band.num_clients = 1
+            band.wants = 0.0
+        return out
+
+    async def _perform_parent_requests(self, retry_number: int):
+        """One GetServerCapacity exchange with the parent: send aggregated
+        demand, re-template local resources from the grants
+        (server.go:227-323). Returns (next_interval, next_retry_number)."""
+        if self._parent_conn is None:
+            from doorman_tpu.client.connection import Connection
+
+            self._parent_conn = Connection(
+                self.parent_addr,
+                minimum_refresh_interval=self.minimum_refresh_interval,
+            )
+        request = self._build_server_capacity_request()
+        try:
+            out = await self._parent_conn.execute(
+                lambda stub: stub.GetServerCapacity(request)
+            )
+        except Exception:
+            log.exception("%s: GetServerCapacity to parent failed", self.id)
+            return (
+                backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number),
+                retry_number + 1,
+            )
+
+        interval = VERY_LONG_TIME
+        templates: List[pb.ResourceTemplate] = []
+        expiry_times: Dict[str, float] = {}
+        for pr in out.response:
+            if pr.resource_id not in self.resources:
+                if pr.resource_id != "*":
+                    log.error(
+                        "%s: response for unknown resource %r",
+                        self.id, pr.resource_id,
+                    )
+                continue
+            expiry_times[pr.resource_id] = float(pr.gets.expiry_time)
+            tpl = pb.ResourceTemplate(
+                identifier_glob=pr.resource_id,
+                capacity=pr.gets.capacity,
+                safe_capacity=pr.safe_capacity,
+            )
+            tpl.algorithm.CopyFrom(pr.algorithm)
+            templates.append(tpl)
+            interval = min(interval, float(pr.gets.refresh_interval))
+        templates.append(default_resource_template())
+        try:
+            await self.load_config(
+                pb.ResourceRepository(resources=templates), expiry_times
+            )
+        except config_mod.ConfigError:
+            log.exception("%s: loading parent-derived config failed", self.id)
+            return (
+                backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number),
+                retry_number + 1,
+            )
+        if interval < self.minimum_refresh_interval or interval == VERY_LONG_TIME:
+            interval = self.minimum_refresh_interval
+        return interval, 0
+
+    async def _updater_loop(self) -> None:
+        interval, retry = DEFAULT_INTERVAL, 0
+        while True:
+            await asyncio.sleep(interval)
+            interval, retry = await self._perform_parent_requests(retry)
+
+    # ------------------------------------------------------------------
+    # Status views
+    # ------------------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "id": self.id,
+            "is_master": self.is_master,
+            "election": str(self.election),
+            "current_master": self.current_master,
+            "mode": self.mode,
+            "resources": {
+                rid: res.status() for rid, res in self.resources.items()
+            },
+            "config": (
+                config_mod.repository_to_yaml(self.config)
+                if self.config is not None
+                else ""
+            ),
+        }
+
+    def resource_lease_status(self, resource_id: str):
+        res = self.resources.get(resource_id)
+        if res is None:
+            return None
+        return res.store.lease_status()

@@ -46,6 +46,7 @@ class BatchSolver:
         self._solve = solve_tick_jit
         self.ticks = 0
         self.last_tick_seconds = 0.0
+        self._tick_start = 0.0
 
     def _to_device(self, arr: np.ndarray):
         return jax.device_put(arr, self._device)
@@ -75,23 +76,40 @@ class BatchSolver:
             specs, rows, dtype=self._dtype, to_device=self._to_device
         )
 
-    def tick(self, resources: Iterable[Resource]) -> Dict[str, Dict[str, float]]:
-        """Run one batched tick over `resources`; returns
-        {resource_id: {client_id: new_grant}} and writes grants back into
-        the stores with fresh lease expiries."""
-        start = self._clock()
+    def prepare(self, resources: Iterable[Resource]) -> Snapshot:
+        """Phase 1 (host, must run in the thread that owns the stores):
+        sweep expired leases and pack the snapshot."""
+        self._tick_start = self._clock()
         res_list = list(resources)
-        by_id = {r.id: r for r in res_list}
         for r in res_list:
             r.store.clean()
-        snap = self.snapshot(res_list)
-        gets = np.asarray(jax.block_until_ready(self._solve(snap.edges, snap.resources)))
+        return self.snapshot(res_list)
 
+    def solve(self, snap: Snapshot) -> np.ndarray:
+        """Phase 2 (device; blocking — safe to run in an executor thread,
+        touches no host store state)."""
+        return np.asarray(
+            jax.block_until_ready(self._solve(snap.edges, snap.resources))
+        )
+
+    def apply(
+        self,
+        resources: Iterable[Resource],
+        snap: Snapshot,
+        gets: np.ndarray,
+    ) -> Dict[str, Dict[str, float]]:
+        """Phase 3 (host, store-owning thread): write grants back with
+        fresh lease expiries. Demand that changed while the solve was in
+        flight is preserved (wants/subclients are re-read from the store),
+        and clients released mid-solve stay released."""
+        by_id = {r.id: r for r in resources}
         out: Dict[str, Dict[str, float]] = {}
         for (resource_id, client_id), grant in snap.unpack(
             gets[: snap.num_edges]
         ).items():
-            res = by_id[resource_id]
+            res = by_id.get(resource_id)
+            if res is None or not res.store.has_client(client_id):
+                continue
             algo = res.template.algorithm
             old = res.store.get(client_id)
             res.store.assign(
@@ -103,7 +121,15 @@ class BatchSolver:
                 old.subclients,
             )
             out.setdefault(resource_id, {})[client_id] = grant
-
         self.ticks += 1
-        self.last_tick_seconds = self._clock() - start
+        self.last_tick_seconds = self._clock() - self._tick_start
         return out
+
+    def tick(self, resources: Iterable[Resource]) -> Dict[str, Dict[str, float]]:
+        """Run one synchronous batched tick (prepare + solve + apply); for
+        concurrent servers, run the three phases separately so only `solve`
+        leaves the store-owning thread."""
+        res_list = list(resources)
+        snap = self.prepare(res_list)
+        gets = self.solve(snap)
+        return self.apply(res_list, snap, gets)

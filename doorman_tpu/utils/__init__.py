@@ -1,0 +1,3 @@
+"""Support utilities: backoff, env-var flag population."""
+
+from doorman_tpu.utils.backoff import backoff  # noqa: F401

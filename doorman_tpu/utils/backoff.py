@@ -1,0 +1,22 @@
+"""Exponential backoff (capability parity with reference
+go/timeutil/timeutil.go:26-37: factor 1.3, clamped to [base, max])."""
+
+from __future__ import annotations
+
+_FACTOR = 1.3
+
+# Shared retry/refresh timing defaults (reference server.go:82-90 and
+# connection.go:30-38 use the same values).
+MIN_BACKOFF = 1.0
+MAX_BACKOFF = 60.0
+VERY_LONG_TIME = 60.0 * 60
+
+
+def backoff(base: float, maximum: float, retries: int) -> float:
+    """Delay in seconds growing exponentially with `retries` from `base`,
+    clamped to `maximum`."""
+    delay = float(base)
+    while delay < maximum and retries > 0:
+        delay *= _FACTOR
+        retries -= 1
+    return min(delay, maximum)

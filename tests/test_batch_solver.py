@@ -124,6 +124,36 @@ def test_multiple_resources_mixed_kinds():
     )
 
 
+def test_release_between_snapshot_and_apply_stays_released():
+    # A client released while the device solve is in flight must not be
+    # resurrected by the grant write-back.
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    for c, w in [("a", 60.0), ("b", 60.0)]:
+        res.store.assign(c, 60, 16, 0.0, w, 1)
+    solver = BatchSolver(clock=clock)
+    snap = solver.prepare([res])
+    gets = solver.solve(snap)
+    res.release("a")  # concurrent ReleaseCapacity
+    grants = solver.apply([res], snap, gets)
+    assert "a" not in grants["r0"]
+    assert not res.store.has_client("a")
+    assert res.store.has_client("b")
+
+
+def test_wants_update_mid_solve_is_preserved():
+    clock = FakeClock()
+    res = Resource("r0", template(), clock=clock)
+    res.store.assign("a", 60, 16, 0.0, 50.0, 1)
+    solver = BatchSolver(clock=clock)
+    snap = solver.prepare([res])
+    gets = solver.solve(snap)
+    # Demand changes while the solve is in flight.
+    res.store.assign("a", 60, 16, res.store.get("a").has, 99.0, 1)
+    solver.apply([res], snap, gets)
+    assert res.store.get("a").wants == 99.0  # not clobbered by write-back
+
+
 def test_parent_expiry_zeroes_capacity():
     clock = FakeClock()
     res = Resource("r0", template(), clock=clock)

@@ -1,0 +1,135 @@
+"""Election tests: the TTL-lock state machine (reference election.go:89-172)
+driven over the in-memory KV with fault injection, and server failover
+behavior (state wipe + learning mode on re-election)."""
+
+import asyncio
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.server.election import (
+    InMemoryKV,
+    KVElection,
+    TrivialElection,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Recorder:
+    def __init__(self):
+        self.is_master_events = []
+        self.current_events = []
+        self.master_changed = asyncio.Event()
+        self.current_changed = asyncio.Event()
+
+    async def on_is_master(self, is_master):
+        self.is_master_events.append(is_master)
+        self.master_changed.set()
+
+    async def on_current(self, current):
+        self.current_events.append(current)
+        self.current_changed.set()
+
+    async def wait_master_change(self, timeout=5):
+        await asyncio.wait_for(self.master_changed.wait(), timeout)
+        self.master_changed.clear()
+
+
+def test_trivial_election_wins_immediately():
+    async def body():
+        rec = Recorder()
+        await TrivialElection().run("me", rec.on_is_master, rec.on_current)
+        assert rec.is_master_events == [True]
+        assert rec.current_events == ["me"]
+
+    run(body())
+
+
+def test_kv_election_single_candidate_wins():
+    async def body():
+        kv = InMemoryKV()
+        election = KVElection(kv, "/lock", ttl=0.3)
+        rec = Recorder()
+        await election.run("a", rec.on_is_master, rec.on_current)
+        await rec.wait_master_change()
+        assert rec.is_master_events == [True]
+        assert await kv.get("/lock") == "a"
+        await election.stop()
+
+    run(body())
+
+
+def test_kv_election_second_candidate_loses():
+    async def body():
+        kv = InMemoryKV()
+        e1 = KVElection(kv, "/lock", ttl=0.5)
+        e2 = KVElection(kv, "/lock", ttl=0.5)
+        r1, r2 = Recorder(), Recorder()
+        await e1.run("a", r1.on_is_master, r1.on_current)
+        await r1.wait_master_change()
+        await e2.run("b", r2.on_is_master, r2.on_current)
+        await asyncio.sleep(0.3)
+        assert r2.is_master_events == []  # b never wins while a renews
+        assert await kv.get("/lock") == "a"
+        await e1.stop()
+        await e2.stop()
+
+    run(body())
+
+
+def test_kv_election_failover_on_expiry():
+    async def body():
+        kv = InMemoryKV()
+        e1 = KVElection(kv, "/lock", ttl=0.3)
+        r1 = Recorder()
+        await e1.run("a", r1.on_is_master, r1.on_current)
+        await r1.wait_master_change()
+        assert r1.is_master_events == [True]
+
+        # Fault injection: the lock vanishes (as if etcd expired it) and a
+        # rival takes it; a's next renewal fails => mastership lost.
+        kv.expire("/lock")
+        assert await kv.acquire("/lock", "b", 10.0)
+        await r1.wait_master_change()
+        assert r1.is_master_events == [True, False]
+        await e1.stop()
+
+    run(body())
+
+
+def test_server_failover_wipes_state_and_relearns():
+    async def body():
+        from doorman_tpu.proto import doorman_pb2 as pb
+        from doorman_tpu.server.config import parse_yaml_config
+        from doorman_tpu.server.server import CapacityServer
+
+        server = CapacityServer("s1", TrivialElection())
+        await server.load_config(
+            parse_yaml_config(
+                """
+resources:
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60, refresh_interval: 1}
+"""
+            )
+        )
+        await server._on_is_master(True)
+        res = server.get_or_create_resource("r")
+        res.store.assign("c1", 60, 1, 10.0, 10.0, 1)
+        assert server.resources
+
+        # Losing mastership wipes all lease state (server.go:438-455).
+        await server._on_is_master(False)
+        assert server.resources == {}
+        assert not server.is_master
+
+        # Winning again restarts learning mode from the new
+        # became_master_at.
+        await server._on_is_master(True)
+        res = server.get_or_create_resource("r")
+        assert res.in_learning_mode
+
+    run(body())

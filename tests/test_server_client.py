@@ -1,0 +1,349 @@
+"""Integration tests over real gRPC on loopback (capability parity with
+reference server_test.go / client_test.go): mastership redirect, learning
+mode, release, config hot-swap, GetServerCapacity validation, the client
+refresh loop, and the batch (TPU-tick) serving mode."""
+
+import asyncio
+
+import grpc
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.client import Client, Connection
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto.grpc_api import (
+    CapacityServicer,
+    CapacityStub,
+    add_capacity_servicer,
+)
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+CONFIG = """
+resources:
+- identifier_glob: proportional
+  capacity: 100
+  safe_capacity: 2
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+- identifier_glob: "*"
+  capacity: 120
+  algorithm: {kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
+LEARNING_CONFIG = """
+resources:
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 100}
+"""
+
+
+async def make_server(mode="immediate", config=CONFIG, **kwargs):
+    server = CapacityServer(
+        "test-server", TrivialElection(), mode=mode,
+        minimum_refresh_interval=0.0, **kwargs,
+    )
+    port = await server.start(0, host="127.0.0.1")
+    await server.load_config(parse_yaml_config(config))
+    await asyncio.sleep(0)  # let election callbacks land
+    server.current_master = f"127.0.0.1:{port}"
+    return server, f"127.0.0.1:{port}"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def capacity_request(client_id, resource_id, wants, has=None):
+    req = pb.GetCapacityRequest(client_id=client_id)
+    rr = req.resource.add()
+    rr.resource_id = resource_id
+    rr.wants = wants
+    if has is not None:
+        rr.has.CopyFrom(has)
+    return req
+
+
+def test_discovery():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                out = await stub.Discovery(pb.DiscoveryRequest())
+                assert out.is_master
+                assert out.mastership.master_address == addr
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_get_capacity_immediate():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                out = await stub.GetCapacity(
+                    capacity_request("client-1", "proportional", 40.0)
+                )
+                assert len(out.response) == 1
+                resp = out.response[0]
+                assert resp.resource_id == "proportional"
+                assert resp.gets.capacity == 40.0
+                assert resp.safe_capacity == 2.0
+                assert resp.gets.refresh_interval == 1
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_invalid_request_rejected():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await stub.GetCapacity(
+                        capacity_request("", "proportional", 40.0)
+                    )
+                assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_mastership_redirect():
+    async def body():
+        server, addr = await make_server()
+
+        # A fake non-master that always points at the real server
+        # (mirrors reference client_test.go:117-172).
+        class NonMaster(CapacityServicer):
+            async def GetCapacity(self, request, context):
+                out = pb.GetCapacityResponse()
+                out.mastership.master_address = addr
+                return out
+
+            async def Discovery(self, request, context):
+                out = pb.DiscoveryResponse(is_master=False)
+                out.mastership.master_address = addr
+                return out
+
+        fake = grpc.aio.server()
+        add_capacity_servicer(fake, NonMaster())
+        fake_port = fake.add_insecure_port("127.0.0.1:0")
+        await fake.start()
+        try:
+            conn = Connection(f"127.0.0.1:{fake_port}", max_retries=2)
+            out = await conn.execute(
+                lambda stub: stub.GetCapacity(
+                    capacity_request("client-1", "proportional", 10.0)
+                )
+            )
+            assert out.response[0].gets.capacity == 10.0
+            assert conn.current_master == addr
+            await conn.close()
+        finally:
+            await fake.stop(None)
+            await server.stop()
+
+    run(body())
+
+
+def test_learning_mode_and_post_learning_clamp():
+    async def body():
+        server, addr = await make_server(config=LEARNING_CONFIG)
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                # During learning mode the server grants whatever the client
+                # reports it has (even over capacity).
+                has = pb.Lease(expiry_time=2**31, refresh_interval=1,
+                               capacity=300.0)
+                out = await stub.GetCapacity(
+                    capacity_request("c1", "proportional", 300.0, has)
+                )
+                assert out.response[0].gets.capacity == 300.0
+
+                # Leave learning mode (rewind became_master_at, like the
+                # reference test rewinds it, server_test.go:339-382).
+                server.became_master_at -= 10_000
+                for res in server.resources.values():
+                    res.learning_mode_end = 0.0
+
+                out = await stub.GetCapacity(
+                    capacity_request("c1", "proportional", 300.0, has)
+                )
+                assert out.response[0].gets.capacity <= 100.0
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_release_capacity():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                await stub.GetCapacity(
+                    capacity_request("c1", "proportional", 40.0)
+                )
+                assert server.resources["proportional"].store.has_client("c1")
+                out = await stub.ReleaseCapacity(
+                    pb.ReleaseCapacityRequest(
+                        client_id="c1", resource_id=["proportional", "ghost"]
+                    )
+                )
+                assert not out.HasField("mastership")
+                assert not server.resources["proportional"].store.has_client(
+                    "c1"
+                )
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_config_hot_swap():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                await stub.GetCapacity(capacity_request("c1", "res", 10.0))
+                new_config = parse_yaml_config(
+                    """
+resources:
+- identifier_glob: "*"
+  capacity: 7
+  algorithm: {kind: STATIC, lease_length: 60, refresh_interval: 1}
+"""
+                )
+                await server.load_config(new_config)
+                out = await stub.GetCapacity(
+                    capacity_request("c1", "res", 10.0)
+                )
+                # STATIC grants min(per-client capacity, wants) = 7.
+                assert out.response[0].gets.capacity == 7.0
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_get_server_capacity_and_validation():
+    async def body():
+        server, addr = await make_server()
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                req = pb.GetServerCapacityRequest(server_id="downstream")
+                rr = req.resource.add()
+                rr.resource_id = "proportional"
+                band = rr.wants.add()
+                band.priority = 1
+                band.num_clients = 5
+                band.wants = 250.0
+                out = await stub.GetServerCapacity(req)
+                resp = out.response[0]
+                assert resp.resource_id == "proportional"
+                assert resp.gets.capacity == 100.0  # whole capacity, one asker
+                assert resp.algorithm.kind == pb.Algorithm.PROPORTIONAL_SHARE
+                # subclients must be >= 1
+                bad = pb.GetServerCapacityRequest(server_id="downstream")
+                rr = bad.resource.add()
+                rr.resource_id = "proportional"
+                band = rr.wants.add()
+                band.priority = 1
+                band.num_clients = 0
+                band.wants = 1.0
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await stub.GetServerCapacity(bad)
+                assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_batch_mode_serves_solved_grants():
+    async def body():
+        server, addr = await make_server(mode="batch")
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                # First round: unknown clients go through the immediate path.
+                for c, w in [("a", 60.0), ("b", 60.0), ("c", 10.0)]:
+                    await stub.GetCapacity(
+                        capacity_request(c, "proportional", w)
+                    )
+                # Batched tick rebalances everyone at once.
+                await server.tick_once()
+                await server.tick_once()
+                out = await stub.GetCapacity(
+                    capacity_request("b", "proportional", 60.0)
+                )
+                # Solved grant: 60 * 100/130.
+                assert out.response[0].gets.capacity == pytest.approx(
+                    60.0 * 100.0 / 130.0
+                )
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_client_refresh_loop():
+    async def body():
+        server, addr = await make_server()
+        try:
+            client = await Client.connect(
+                addr, "itest-client", minimum_refresh_interval=0.05
+            )
+            res = await client.resource("proportional", 30.0)
+            capacity = await asyncio.wait_for(res.capacity().get(), timeout=5)
+            assert capacity == 30.0
+            # Raising wants refreshes to a bigger grant on the next cycle.
+            await res.ask(80.0)
+            capacity = await asyncio.wait_for(res.capacity().get(), timeout=5)
+            assert capacity == 80.0
+            await res.release()
+            assert not server.resources["proportional"].store.has_client(
+                "itest-client"
+            )
+            await client.close()
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_not_master_redirects_client():
+    async def body():
+        server, addr = await make_server()
+        try:
+            server.is_master = False
+            server.current_master = ""
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                out = await stub.GetCapacity(
+                    capacity_request("c1", "proportional", 10.0)
+                )
+                assert out.HasField("mastership")
+                assert not out.mastership.HasField("master_address")
+        finally:
+            await server.stop()
+
+    run(body())

@@ -1,0 +1,42 @@
+"""Backoff and flagenv tests (parity with reference timeutil/flagenv
+tests)."""
+
+import argparse
+
+from doorman_tpu.utils.backoff import backoff
+from doorman_tpu.utils.flagenv import flag_to_env, populate
+
+
+def test_backoff_growth_and_clamp():
+    assert backoff(1.0, 60.0, 0) == 1.0
+    assert backoff(1.0, 60.0, 1) == 1.3
+    assert abs(backoff(1.0, 60.0, 2) - 1.69) < 1e-9
+    assert backoff(1.0, 60.0, 1000) == 60.0
+
+
+def test_flag_to_env():
+    assert flag_to_env("DOORMAN", "config") == "DOORMAN_CONFIG"
+    assert flag_to_env("DOORMAN", "debug-port") == "DOORMAN_DEBUG_PORT"
+
+
+def test_populate_from_env(monkeypatch):
+    monkeypatch.setenv("DOORMAN_PORT", "1234")
+    monkeypatch.setenv("DOORMAN_CONFIG", "file:/tmp/x.yml")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--config", default="")
+    parser.add_argument("--other", default="unchanged")
+    populate(parser, "DOORMAN")
+    args = parser.parse_args([])
+    assert args.port == 1234
+    assert args.config == "file:/tmp/x.yml"
+    assert args.other == "unchanged"
+
+
+def test_command_line_beats_env(monkeypatch):
+    monkeypatch.setenv("DOORMAN_PORT", "1234")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    populate(parser, "DOORMAN")
+    args = parser.parse_args(["--port", "7"])
+    assert args.port == 7
