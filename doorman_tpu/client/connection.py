@@ -24,6 +24,15 @@ log = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
+_NON_RETRIABLE = frozenset(
+    {
+        grpc.StatusCode.INVALID_ARGUMENT,
+        grpc.StatusCode.UNIMPLEMENTED,
+        grpc.StatusCode.PERMISSION_DENIED,
+        grpc.StatusCode.UNAUTHENTICATED,
+    }
+)
+
 
 class Connection:
     """A channel to "the current master", starting from a seed address."""
@@ -87,6 +96,14 @@ class Connection:
                         break
                 try:
                     out = await call(self.stub)
+                except grpc.aio.AioRpcError as e:
+                    if e.code() in _NON_RETRIABLE:
+                        # Deterministic failure (bad request, unimplemented):
+                        # retrying the identical call can never succeed.
+                        raise
+                    last_error = e
+                    await self.close()
+                    break
                 except Exception as e:
                     last_error = e
                     await self.close()

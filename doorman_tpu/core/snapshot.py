@@ -20,7 +20,8 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from doorman_tpu.solver.kernels import AlgoKind, EdgeBatch, ResourceBatch
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.solver.kernels import EdgeBatch, ResourceBatch
 
 
 def _bucket(n: int, minimum: int = 64) -> int:

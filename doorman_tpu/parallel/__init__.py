@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: device meshes and the client-axis sharded solve.
+
+The reference scales by a tree of servers — intermediate servers aggregate
+their clients' demand into priority bands and forward it to the root
+(reference doc/design.md:204-220, server.go:822-901). On TPU the same
+structure is fused on-chip: the edge list shards across devices over a mesh
+axis ("clients" = the leaf/intermediate role), per-resource aggregates
+combine with psum over ICI (= band aggregation), and every device then
+computes its shard's grants from the replicated totals (= the root solve).
+A second mesh axis ("dc") models the two-level tree.
+"""
+
+from doorman_tpu.parallel.mesh import make_mesh  # noqa: F401
+from doorman_tpu.parallel.sharded import (  # noqa: F401
+    make_sharded_solver,
+    shard_edges,
+)

@@ -1,0 +1,33 @@
+"""Device mesh helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("clients",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    With the default single "clients" axis, all devices shard the edge list.
+    For the two-level tree pass axis_names=("dc", "clients") and the per-axis
+    sizes (their product must equal the device count).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = [len(devices)] if len(axis_names) == 1 else None
+    if axis_sizes is None:
+        raise ValueError("axis_sizes required for multi-axis meshes")
+    if int(np.prod(axis_sizes)) != len(devices):
+        raise ValueError(
+            f"axis sizes {axis_sizes} do not cover {len(devices)} devices"
+        )
+    dev_array = np.array(devices).reshape(axis_sizes)
+    return Mesh(dev_array, axis_names)

@@ -1,0 +1,173 @@
+"""Client-axis sharded solve: the reference's server tree fused on-chip.
+
+The edge list shards across devices along a mesh axis; each device computes
+partial per-resource aggregates over its shard and the totals are combined
+with psum over the mesh (ICI) — exactly the aggregation an intermediate
+doorman server performs over its clients before asking the root
+(reference server.go:227-261, doorman.proto PriorityBandAggregate). Every
+device then computes final grants for its own edges from the replicated
+totals; no further communication is needed.
+
+With a two-axis mesh ("dc", "clients") the psum runs over both axes — the
+partial-sum-within-dc / combine-across-dc structure is the two-level tree
+of BASELINE.json config 4; `dc_aggregates` exposes the per-dc partials
+(the intermediate servers' band tables) for observability.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from doorman_tpu.solver.kernels import EdgeBatch, ResourceBatch, solve_edges
+
+
+def _psum_reduce(local_reduce, axis_names):
+    def reduce_fn(values):
+        return jax.lax.psum(local_reduce(values), axis_names)
+
+    return reduce_fn
+
+
+def _psum_max(local_reduce, axis_names):
+    def reduce_fn(values):
+        return jax.lax.pmax(local_reduce(values), axis_names)
+
+    return reduce_fn
+
+
+def make_sharded_solver(mesh: Mesh, *, donate: bool = False):
+    """Build a jitted solve(edges, resources) -> gets running under
+    shard_map over `mesh`: edge arrays sharded over all mesh axes, resource
+    arrays replicated, per-resource totals combined with psum/pmax."""
+    axes = tuple(mesh.axis_names)
+    edge_spec = P(axes)  # edge axis sharded over every mesh axis
+    rep = P()
+
+    def shard_fn(rid, wants, has, sub, active, cap, kind, learning, static_cap):
+        from doorman_tpu.solver.fairshare import (
+            local_segment_max,
+            local_segment_sum,
+        )
+
+        R = cap.shape[0]
+        edges = EdgeBatch(
+            resource=rid, wants=wants, has=has, subclients=sub, active=active
+        )
+        resources = ResourceBatch(
+            capacity=cap, algo_kind=kind, learning=learning,
+            static_capacity=static_cap,
+        )
+        segsum = _psum_reduce(local_segment_sum(rid, R), axes)
+        segmax = _psum_max(local_segment_max(rid, R), axes)
+        return solve_edges(edges, resources, segsum, segmax)
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            edge_spec, edge_spec, edge_spec, edge_spec, edge_spec,
+            rep, rep, rep, rep,
+        ),
+        out_specs=edge_spec,
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2) if donate else ())
+    def solve(edges: EdgeBatch, resources: ResourceBatch) -> jax.Array:
+        return mapped(
+            edges.resource, edges.wants, edges.has, edges.subclients,
+            edges.active,
+            resources.capacity, resources.algo_kind, resources.learning,
+            resources.static_capacity,
+        )
+
+    return solve
+
+
+def shard_edges(mesh: Mesh, edges: EdgeBatch) -> EdgeBatch:
+    """Place an EdgeBatch on the mesh: edge arrays sharded over all mesh
+    axes. The edge axis is padded (inactive edges) up to a multiple of the
+    device count so every shard is equal-sized."""
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    E = int(np.asarray(edges.active).shape[0])
+    pad = (-E) % n_dev
+    if pad:
+        def extend(arr, fill):
+            arr = np.asarray(arr)
+            return np.concatenate(
+                [arr, np.full((pad,), fill, dtype=arr.dtype)]
+            )
+
+        # Pad with the last (maximal) resource id: keeps the edge list
+        # sorted by segment id, which the segment reductions rely on.
+        rid = np.asarray(edges.resource)
+        last_rid = rid[-1] if rid.size else 0
+        edges = EdgeBatch(
+            resource=extend(edges.resource, last_rid),
+            wants=extend(edges.wants, 0),
+            has=extend(edges.has, 0),
+            subclients=extend(edges.subclients, 0),
+            active=extend(edges.active, False),
+        )
+    spec = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    put = lambda a: jax.device_put(a, spec)
+    return EdgeBatch(
+        resource=put(edges.resource),
+        wants=put(edges.wants),
+        has=put(edges.has),
+        subclients=put(edges.subclients),
+        active=put(edges.active),
+    )
+
+
+def replicate_resources(mesh: Mesh, resources: ResourceBatch) -> ResourceBatch:
+    spec = NamedSharding(mesh, P())
+    put = lambda a: jax.device_put(a, spec)
+    return ResourceBatch(
+        capacity=put(resources.capacity),
+        algo_kind=put(resources.algo_kind),
+        learning=put(resources.learning),
+        static_capacity=put(resources.static_capacity),
+    )
+
+
+def dc_aggregates(mesh: Mesh, edges: EdgeBatch, num_resources: int):
+    """Per-dc (first mesh axis) aggregate tables — the on-chip analog of
+    each intermediate server's PriorityBandAggregate report: for every dc,
+    per-resource (sum_wants, sum_has, num_subclients). Returns three arrays
+    of shape [n_dc, R]."""
+    if len(mesh.axis_names) < 2:
+        raise ValueError("dc_aggregates needs a two-axis ('dc', ...) mesh")
+    axes = tuple(mesh.axis_names)
+    dc_axis, client_axes = axes[0], axes[1:]
+    edge_spec = P(axes)
+
+    def shard_fn(rid, wants, has, sub, active):
+        from doorman_tpu.solver.fairshare import local_segment_sum
+
+        segsum = local_segment_sum(rid, num_resources)
+        zero = jnp.zeros((), wants.dtype)
+        w = jnp.where(active, wants, zero)
+        h = jnp.where(active, has, zero)
+        s = jnp.where(active, sub, zero)
+        # Combine across the client axes only: one [R] row per dc.
+        row = lambda v: jax.lax.psum(segsum(v), client_axes)
+        return (
+            row(w)[None, :], row(h)[None, :], row(s)[None, :],
+        )
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(edge_spec,) * 5,
+        out_specs=(P(dc_axis, None),) * 3,
+    )
+    return jax.jit(mapped)(
+        edges.resource, edges.wants, edges.has, edges.subclients, edges.active
+    )

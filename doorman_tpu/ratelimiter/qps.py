@@ -65,6 +65,10 @@ class QPSRateLimiter:
             self._recalculate(int(capacity), 1000)
         self._released_subintervals = 0
         self._leftover_remaining = self._leftover
+        # Permits computed under the old capacity must not survive the
+        # change (the reference's unbuffered unfreeze channel cannot carry
+        # permits across an update either).
+        self._budget = 0
 
     @property
     def unlimited(self) -> bool:

@@ -7,11 +7,16 @@ on device. Algorithm choice is a per-resource lane selected by `algo_kind`,
 so a single compiled executable covers every configured algorithm.
 """
 
+from doorman_tpu.algorithms.kinds import AlgoKind  # noqa: F401
 from doorman_tpu.solver.kernels import (  # noqa: F401
-    AlgoKind,
     EdgeBatch,
     ResourceBatch,
     solve_tick,
     solve_tick_jit,
+)
+from doorman_tpu.solver.dense import (  # noqa: F401
+    DenseBatch,
+    solve_dense,
+    solve_dense_jit,
 )
 from doorman_tpu.solver.fairshare import waterfill_levels  # noqa: F401

@@ -1,37 +1,45 @@
-"""Weighted max-min water-filling on device, segmented over resources.
+"""Edge-list water-filling API + the segment-reduction builders.
 
-The reference documents fair share as iterative water-filling
-(/root/reference/doc/algorithms.md:59-69) but implements a two-round
-truncation (go/server/doorman/algorithm.go:95-211). The batch solver uses
-the full water-fill: for each overloaded resource find the level L such that
-
-    sum_i min(wants_i, L * weight_i) == capacity
-
-and grant min(wants_i, L * weight_i). The level is found by bisection on a
-replicated [R] array (every iteration is one masked segment-sum over the
-edge list — compiler-friendly, no data-dependent shapes), then snapped to
-the exact closed form L = (capacity - sum_sat_wants) / sum_unsat_weights
-so results are bit-identical to the sorting-based numpy oracle
-(doorman_tpu.algorithms.tick.waterfill_level) on exactly-representable
-inputs.
+The water-fill algorithm itself (bisection + exact closed-form snap; the
+full iterative fair share the reference only documents,
+/root/reference/doc/algorithms.md:59-69, versus its two-round truncation in
+algorithm.go:95-211) lives in doorman_tpu.solver.lanes.waterfill_level,
+shared by every layout. This module provides the edge-list-shaped wrapper
+and the local segment reductions that both the single-chip and sharded
+(psum-combined) paths build on.
 """
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 import jax
 import jax.numpy as jnp
 
-_BISECT_ITERS = 48
-_REFINE_ITERS = 2
+from doorman_tpu.solver.lanes import waterfill_level
+
+# [E] values -> [R] per-resource reduction.
+SegmentReduce = Callable[[jax.Array], jax.Array]
 
 
-def _segment_sum(values, segment_ids, num_segments, sorted_ids):
-    return jax.ops.segment_sum(
-        values,
-        segment_ids,
-        num_segments=num_segments,
-        indices_are_sorted=sorted_ids,
-    )
+def local_segment_sum(segment_ids, num_segments, sorted_ids=True) -> SegmentReduce:
+    def segsum(values):
+        return jax.ops.segment_sum(
+            values, segment_ids,
+            num_segments=num_segments, indices_are_sorted=sorted_ids,
+        )
+
+    return segsum
+
+
+def local_segment_max(segment_ids, num_segments, sorted_ids=True) -> SegmentReduce:
+    def segmax(values):
+        return jax.ops.segment_max(
+            values, segment_ids,
+            num_segments=num_segments, indices_are_sorted=sorted_ids,
+        )
+
+    return segmax
 
 
 def waterfill_levels(
@@ -42,60 +50,19 @@ def waterfill_levels(
     active: jax.Array,  # [E] bool
     *,
     num_resources: int,
-    sorted_ids: bool = True,
+    segsum: Optional[SegmentReduce] = None,
+    segmax: Optional[SegmentReduce] = None,
 ) -> jax.Array:
-    """Per-resource water level [R]. For resources whose total wants fit in
-    capacity the level is the max saturation ratio (everyone satisfied)."""
+    """Per-resource water level [R] over an edge list."""
+    if segsum is None:
+        segsum = local_segment_sum(edge_resource, num_resources)
+    if segmax is None:
+        segmax = local_segment_max(edge_resource, num_resources)
     dtype = edge_wants.dtype
-    wants = jnp.where(active, edge_wants, jnp.zeros((), dtype))
-    weights = jnp.where(active, edge_weights, jnp.zeros((), dtype))
-
-    sum_wants = _segment_sum(wants, edge_resource, num_resources, sorted_ids)
-
-    # Saturation ratio of each edge; inactive edges contribute nothing.
-    safe_w = jnp.maximum(weights, jnp.finfo(dtype).tiny)
-    ratio = jnp.where(weights > 0, wants / safe_w, jnp.zeros((), dtype))
-    max_ratio = jax.ops.segment_max(
-        jnp.where(active, ratio, jnp.full((), -jnp.inf, dtype)),
-        edge_resource,
-        num_segments=num_resources,
-        indices_are_sorted=sorted_ids,
+    zero = jnp.zeros((), dtype)
+    wants = jnp.where(active, edge_wants, zero)
+    weights = jnp.where(active, edge_weights, zero)
+    return waterfill_level(
+        wants, weights, active, capacity,
+        segsum, segmax, lambda totals: totals[edge_resource],
     )
-    max_ratio = jnp.where(jnp.isfinite(max_ratio), max_ratio, 0.0)
-
-    underloaded = sum_wants <= capacity
-
-    def granted_at(level):
-        g = jnp.minimum(wants, level[edge_resource] * weights)
-        return _segment_sum(g, edge_resource, num_resources, sorted_ids)
-
-    def bisect_body(_, carry):
-        lo, hi = carry
-        mid = (lo + hi) * 0.5
-        need_more = granted_at(mid) < capacity
-        return jnp.where(need_more, mid, lo), jnp.where(need_more, hi, mid)
-
-    lo = jnp.zeros_like(capacity)
-    hi = jnp.maximum(max_ratio, jnp.zeros((), dtype))
-    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, bisect_body, (lo, hi))
-    level = hi
-
-    # Snap to the exact closed form: with the saturated set S(level) fixed,
-    # L = (capacity - sum_{S} wants) / sum_{~S} weights. One or two fixed-
-    # point rounds pin the set; this reproduces the oracle's arithmetic.
-    for _ in range(_REFINE_ITERS):
-        sat = wants <= level[edge_resource] * weights
-        sat_wants = _segment_sum(
-            jnp.where(sat, wants, jnp.zeros((), dtype)),
-            edge_resource, num_resources, sorted_ids,
-        )
-        unsat_weight = _segment_sum(
-            jnp.where(sat, jnp.zeros((), dtype), weights),
-            edge_resource, num_resources, sorted_ids,
-        )
-        exact = jnp.where(
-            unsat_weight > 0, (capacity - sat_wants) / jnp.maximum(unsat_weight, jnp.finfo(dtype).tiny), level
-        )
-        level = jnp.where(underloaded, level, jnp.maximum(exact, 0.0))
-
-    return jnp.where(underloaded, max_ratio, level)

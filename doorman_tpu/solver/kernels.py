@@ -1,8 +1,8 @@
-"""The batched per-tick allocation kernel.
+"""The batched per-tick allocation kernel, edge-list layout.
 
 Data model: the (client x resource) wants table is sparse — a client holds
-leases on few resources — so the device representation is an edge list
-("edge" = one client's lease on one resource), segmented by resource id:
+leases on few resources — so this layout is an edge list ("edge" = one
+client's lease on one resource), segmented by resource id:
 
     EdgeBatch:    wants/has/subclients/resource-id/active per edge   [E]
     ResourceBatch: capacity, algo_kind, learning flag, static cap    [R]
@@ -10,11 +10,17 @@ leases on few resources — so the device representation is an edge list
 One `solve_tick` computes new grants for every edge in one XLA executable:
 segment-sums produce the per-resource aggregates, every algorithm is
 evaluated as a vectorized lane over all edges, and `algo_kind` selects the
-lane per resource. This replaces the reference's per-request O(clients)
-loop (/root/reference/go/server/doorman/server.go:800-817 fanning out to
-algorithm.go) with a single data-parallel solve; semantics are the batch
-snapshot semantics defined by the numpy oracles in
+lane per resource (the lane math lives in doorman_tpu.solver.lanes, shared
+with the dense layout). This replaces the reference's per-request
+O(clients) loop (/root/reference/go/server/doorman/server.go:800-817
+fanning out to algorithm.go) with a single data-parallel solve; semantics
+are the batch snapshot semantics defined by the numpy oracles in
 doorman_tpu.algorithms.tick.
+
+The edge-list layout is general (ragged, any mix of resource sizes) and is
+the CPU/sharding workhorse; segment reductions lower to scatter on
+XLA:TPU, so the hot single-chip path uses the dense bucket layout
+(doorman_tpu.solver.dense) instead.
 
 Shapes are static: E and R are padded (see doorman_tpu.core.snapshot) so
 XLA compiles once per bucket size.
@@ -28,8 +34,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from doorman_tpu.algorithms.kinds import AlgoKind
-from doorman_tpu.solver.fairshare import waterfill_levels
+from doorman_tpu.solver.fairshare import (
+    SegmentReduce,
+    local_segment_max,
+    local_segment_sum,
+)
+from doorman_tpu.solver.lanes import solve_lanes
 
 
 @jax.tree_util.register_dataclass
@@ -61,93 +71,42 @@ class ResourceBatch:
         return self.capacity.shape[0]
 
 
-def _seg(values, ids, num_segments):
-    return jax.ops.segment_sum(
-        values, ids, num_segments=num_segments, indices_are_sorted=True
+def solve_edges(
+    edges: EdgeBatch,
+    resources: ResourceBatch,
+    segsum: SegmentReduce,
+    segmax: SegmentReduce,
+) -> jax.Array:
+    """Edge-list solve with injectable per-resource reductions ([E] values
+    -> [R] totals). Single-chip passes local segment sums; the sharded path
+    passes psum-combined ones (the reductions are the ONLY cross-shard
+    communication in the solve)."""
+    rid = edges.resource
+    return solve_lanes(
+        edges.wants,
+        edges.has,
+        edges.subclients,
+        edges.active,
+        resources.capacity,
+        resources.algo_kind,
+        resources.learning,
+        resources.static_capacity,
+        segsum=segsum,
+        segmax=segmax,
+        expand=lambda totals: totals[rid],
     )
 
 
 def solve_tick(edges: EdgeBatch, resources: ResourceBatch) -> jax.Array:
-    """Compute new grants for every edge. Returns gets [E] (padding lanes
-    produce 0)."""
+    """Single-chip edge-list solve: compute new grants for every edge.
+    Returns gets [E] (padding lanes produce 0)."""
     R = resources.num_resources
-    dtype = edges.wants.dtype
-    zero = jnp.zeros((), dtype)
-    rid = edges.resource
-
-    wants = jnp.where(edges.active, edges.wants, zero)
-    has = jnp.where(edges.active, edges.has, zero)
-    sub = jnp.where(edges.active, edges.subclients, zero)
-
-    sum_wants = _seg(wants, rid, R)  # [R]
-    sum_has = _seg(has, rid, R)  # [R]
-    count = _seg(sub, rid, R)  # [R]
-
-    cap_r = resources.capacity
-    cap_e = cap_r[rid]
-
-    # ---- Lane: NO_ALGORITHM — everyone gets what they want.
-    gets_none = wants
-
-    # ---- Lane: STATIC — per-client configured cap.
-    gets_static = jnp.minimum(resources.static_capacity[rid], wants)
-
-    # ---- Lane: LEARN — replay the client's self-reported grant.
-    gets_learn = has
-
-    # ---- Lane: PROPORTIONAL_SHARE (simulation semantics,
-    # algo_proportional.py:31-65): pure scaling by capacity / all_wants in
-    # overload, clamped by the free capacity as seen from the snapshot
-    # (own previous grant excluded from the outstanding-lease sum).
-    free = jnp.maximum(cap_e - (sum_has[rid] - has), zero)
-    underloaded_e = (sum_wants < cap_r)[rid]
-    safe_sum_wants = jnp.maximum(sum_wants[rid], jnp.finfo(dtype).tiny)
-    scaled = wants * (cap_e / safe_sum_wants)
-    gets_prop = jnp.where(
-        underloaded_e, jnp.minimum(wants, free), jnp.minimum(scaled, free)
+    return solve_edges(
+        edges,
+        resources,
+        local_segment_sum(edges.resource, R),
+        local_segment_max(edges.resource, R),
     )
-
-    # ---- Lane: PROPORTIONAL_TOPUP (Go semantics, snapshot form):
-    # equal share + top-up funded by clients under their equal share.
-    safe_count = jnp.maximum(count[rid], jnp.finfo(dtype).tiny)
-    equal = (cap_e / safe_count) * sub
-    under = wants < equal
-    extra_capacity = _seg(jnp.where(under, equal - wants, zero), rid, R)[rid]
-    extra_need = _seg(jnp.where(under, zero, wants - equal), rid, R)[rid]
-    topped = equal + (wants - equal) * (
-        extra_capacity / jnp.maximum(extra_need, jnp.finfo(dtype).tiny)
-    )
-    fits = (sum_wants <= cap_r)[rid]
-    gets_topup = jnp.where(
-        fits | (wants <= equal),
-        jnp.minimum(wants, free),
-        jnp.minimum(topped, free),
-    )
-
-    # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
-    level = waterfill_levels(
-        cap_r, wants, sub, rid, edges.active, num_resources=R
-    )
-    fair_fits = (sum_wants <= cap_r)[rid]
-    gets_fair = jnp.where(fair_fits, wants, jnp.minimum(wants, level[rid] * sub))
-
-    kind_e = resources.algo_kind[rid]
-    gets = jnp.select(
-        [
-            kind_e == AlgoKind.NO_ALGORITHM,
-            kind_e == AlgoKind.STATIC,
-            kind_e == AlgoKind.PROPORTIONAL_SHARE,
-            kind_e == AlgoKind.FAIR_SHARE,
-            kind_e == AlgoKind.PROPORTIONAL_TOPUP,
-        ],
-        [gets_none, gets_static, gets_prop, gets_fair, gets_topup],
-        default=zero,
-    )
-
-    # Learning-mode resources replay reported grants regardless of lane
-    # (reference resource.go:108-111).
-    gets = jnp.where(resources.learning[rid], gets_learn, gets)
-    return jnp.where(edges.active, gets, zero)
 
 
 solve_tick_jit = jax.jit(solve_tick)

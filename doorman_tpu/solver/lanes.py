@@ -1,0 +1,170 @@
+"""The algorithm lanes, written once over abstract reductions.
+
+All device layouts share this math; they differ only in how per-resource
+totals are computed from per-lease values and broadcast back:
+
+  * edge list   ([E] values,  sorted segment ids): segsum = segment_sum,
+    expand = totals[rid] — kernels.solve_edges (CPU/general, and the
+    sharded path, where segsum additionally psums across the mesh)
+  * dense bucket ([R, K] values): segsum = sum(axis=1), expand =
+    totals[:, None] — dense.solve_dense (the TPU-optimal layout)
+
+Semantics are the per-tick snapshot semantics defined by the numpy oracles
+in doorman_tpu.algorithms.tick; every layout must match them bit-for-bit
+on representable inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from doorman_tpu.algorithms.kinds import AlgoKind
+
+_BISECT_ITERS = 48
+_REFINE_ITERS = 2
+
+# lease-shaped values -> per-resource totals, and back.
+Reduce = Callable[[jax.Array], jax.Array]
+Expand = Callable[[jax.Array], jax.Array]
+
+
+def waterfill_level(
+    wants: jax.Array,  # lease-shaped, already masked (inactive -> 0)
+    weights: jax.Array,  # lease-shaped, masked
+    active: jax.Array,  # lease-shaped bool
+    capacity: jax.Array,  # per-resource
+    segsum: Reduce,
+    segmax: Reduce,
+    expand: Expand,
+) -> jax.Array:
+    """Per-resource water level for weighted max-min fair share: bisection
+    to locate the saturated set, then a closed-form snap
+    L = (capacity - sum_sat_wants) / sum_unsat_weights that reproduces the
+    sorting-based numpy oracle's arithmetic exactly. For underloaded
+    resources the level is the max saturation ratio (everyone satisfied)."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    sum_wants = segsum(wants)
+    safe_w = jnp.maximum(weights, jnp.finfo(dtype).tiny)
+    ratio = jnp.where(weights > 0, wants / safe_w, zero)
+    max_ratio = segmax(jnp.where(active, ratio, jnp.full((), -jnp.inf, dtype)))
+    max_ratio = jnp.where(jnp.isfinite(max_ratio), max_ratio, 0.0)
+    underloaded = sum_wants <= capacity
+
+    def granted_at(level):
+        return segsum(jnp.minimum(wants, expand(level) * weights))
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) * 0.5
+        need_more = granted_at(mid) < capacity
+        return jnp.where(need_more, mid, lo), jnp.where(need_more, hi, mid)
+
+    lo = jnp.zeros_like(capacity)
+    hi = jnp.maximum(max_ratio, 0.0)
+    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
+    level = hi
+    for _ in range(_REFINE_ITERS):
+        sat = wants <= expand(level) * weights
+        sat_wants = segsum(jnp.where(sat, wants, zero))
+        unsat_weight = segsum(jnp.where(sat, zero, weights))
+        exact = jnp.where(
+            unsat_weight > 0,
+            (capacity - sat_wants)
+            / jnp.maximum(unsat_weight, jnp.finfo(dtype).tiny),
+            level,
+        )
+        level = jnp.where(underloaded, level, jnp.maximum(exact, 0.0))
+    return jnp.where(underloaded, max_ratio, level)
+
+
+def solve_lanes(
+    wants: jax.Array,  # lease-shaped
+    has: jax.Array,
+    subclients: jax.Array,
+    active: jax.Array,  # bool
+    capacity: jax.Array,  # per-resource
+    algo_kind: jax.Array,  # per-resource int
+    learning: jax.Array,  # per-resource bool
+    static_capacity: jax.Array,  # per-resource
+    segsum: Reduce,
+    segmax: Reduce,
+    expand: Expand,
+) -> jax.Array:
+    """Grants, lease-shaped; inactive lanes produce 0."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    tiny = jnp.finfo(dtype).tiny
+    wants = jnp.where(active, wants, zero)
+    has = jnp.where(active, has, zero)
+    sub = jnp.where(active, subclients, zero)
+    cap_e = expand(capacity)
+
+    sum_wants = segsum(wants)  # per-resource
+    sum_has = segsum(has)
+    count = segsum(sub)
+
+    # ---- Lane: NO_ALGORITHM — everyone gets what they want.
+    gets_none = wants
+
+    # ---- Lane: STATIC — per-client configured cap.
+    gets_static = jnp.minimum(expand(static_capacity), wants)
+
+    # ---- Lane: LEARN — replay the client's self-reported grant.
+    gets_learn = has
+
+    # ---- Lane: PROPORTIONAL_SHARE (simulation semantics,
+    # algo_proportional.py:31-65): pure scaling by capacity / all_wants in
+    # overload, clamped by the free capacity as seen from the snapshot
+    # (own previous grant excluded from the outstanding-lease sum).
+    free = jnp.maximum(cap_e - (expand(sum_has) - has), zero)
+    underloaded = expand(sum_wants < capacity)
+    scaled = wants * (cap_e / expand(jnp.maximum(sum_wants, tiny)))
+    gets_prop = jnp.where(
+        underloaded, jnp.minimum(wants, free), jnp.minimum(scaled, free)
+    )
+
+    # ---- Lane: PROPORTIONAL_TOPUP (Go semantics, snapshot form,
+    # algorithm.go:213-292): equal share + top-up funded by clients under
+    # their equal share.
+    equal = (cap_e / expand(jnp.maximum(count, tiny))) * sub
+    under = wants < equal
+    extra_capacity = expand(segsum(jnp.where(under, equal - wants, zero)))
+    extra_need = expand(segsum(jnp.where(under, zero, wants - equal)))
+    topped = equal + (wants - equal) * (
+        extra_capacity / jnp.maximum(extra_need, tiny)
+    )
+    fits = expand(sum_wants <= capacity)
+    gets_topup = jnp.where(
+        fits | (wants <= equal),
+        jnp.minimum(wants, free),
+        jnp.minimum(topped, free),
+    )
+
+    # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
+    level = waterfill_level(
+        wants, sub, active, capacity, segsum, segmax, expand
+    )
+    gets_fair = jnp.where(
+        fits, wants, jnp.minimum(wants, expand(level) * sub)
+    )
+
+    kind_e = expand(algo_kind)
+    gets = jnp.select(
+        [
+            kind_e == AlgoKind.NO_ALGORITHM,
+            kind_e == AlgoKind.STATIC,
+            kind_e == AlgoKind.PROPORTIONAL_SHARE,
+            kind_e == AlgoKind.FAIR_SHARE,
+            kind_e == AlgoKind.PROPORTIONAL_TOPUP,
+        ],
+        [gets_none, gets_static, gets_prop, gets_fair, gets_topup],
+        default=zero,
+    )
+    # Learning-mode resources replay reported grants regardless of lane
+    # (reference resource.go:108-111).
+    gets = jnp.where(expand(learning), gets_learn, gets)
+    return jnp.where(active, gets, zero)

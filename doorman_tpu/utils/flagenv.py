@@ -29,5 +29,13 @@ def populate(parser: argparse.ArgumentParser, prefix: str = "DOORMAN") -> None:
                 raw = action.type(raw)
             elif isinstance(action, argparse._StoreTrueAction):  # noqa: SLF001
                 raw = raw.lower() in ("1", "true", "yes")
+            elif isinstance(action, argparse._StoreFalseAction):  # noqa: SLF001
+                # DOORMAN_NO_FOO=true means "apply the flag": dest = False.
+                raw = raw.lower() not in ("1", "true", "yes")
+            elif not isinstance(action, argparse._StoreAction):  # noqa: SLF001
+                raise ValueError(
+                    f"cannot populate {env}: unsupported action for "
+                    f"--{name}"
+                )
             action.default = raw
             action.required = False

@@ -120,6 +120,22 @@ def test_budget_does_not_accumulate():
     run(body())
 
 
+def test_capacity_drop_discards_stale_budget():
+    async def body():
+        res = FakeResource()
+        rl = new_qps(res)
+        await res.feed(1000.0)  # large per-subinterval budget
+        await asyncio.sleep(0.15)  # budget accrues
+        await res.feed(0.0)  # capacity revoked
+        await asyncio.sleep(0.05)
+        # No stale permits may leak through after the revocation.
+        with pytest.raises(asyncio.TimeoutError):
+            await rl.wait(timeout=0.2)
+        await rl.close()
+
+    run(body())
+
+
 def test_wants_estimate_recency_weighting():
     now = 1000.0
     # 10 calls in the most recent second: weighted sum = 10*10=100,

@@ -33,6 +33,18 @@ def test_populate_from_env(monkeypatch):
     assert args.other == "unchanged"
 
 
+def test_store_true_and_false_from_env(monkeypatch):
+    monkeypatch.setenv("DOORMAN_VERBOSE", "true")
+    monkeypatch.setenv("DOORMAN_NO_COLOR", "true")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--no-color", action="store_false", dest="color")
+    populate(parser, "DOORMAN")
+    args = parser.parse_args([])
+    assert args.verbose is True
+    assert args.color is False  # env var applies the store_false flag
+
+
 def test_command_line_beats_env(monkeypatch):
     monkeypatch.setenv("DOORMAN_PORT", "1234")
     parser = argparse.ArgumentParser()
