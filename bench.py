@@ -79,7 +79,11 @@ def main() -> None:
     from doorman_tpu.solver.dense import DenseBatch, solve_dense
 
     device = jax.devices()[0]
-    dtype = np.float64 if device.platform == "cpu" else np.float32
+    if device.platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
 
     rng = np.random.default_rng(42)
     R, K, C = NUM_RESOURCES, BUCKET_K, CLIENTS_PER_RESOURCE
@@ -119,9 +123,9 @@ def main() -> None:
         for _ in range(TICKS)
     ]
     churn_rows = [
-        (rng.integers(0, 100, (CHURN_RESOURCES, K)) * active[:CHURN_RESOURCES])
+        (rng.integers(0, 100, (CHURN_RESOURCES, K)) * active[churn_idx[t]])
         .astype(dtype)
-        for _ in range(TICKS)
+        for t in range(TICKS)
     ]
     refresh_idx = [
         rng.choice(R, REFRESH_RESOURCES, replace=False).astype(np.int32)

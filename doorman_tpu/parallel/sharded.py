@@ -78,7 +78,9 @@ def make_sharded_solver(mesh: Mesh, *, donate: bool = False):
         out_specs=edge_spec,
     )
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2) if donate else ())
+    # Donate only the per-tick edge arrays; the replicated resource config
+    # is reused across ticks.
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def solve(edges: EdgeBatch, resources: ResourceBatch) -> jax.Array:
         return mapped(
             edges.resource, edges.wants, edges.has, edges.subclients,

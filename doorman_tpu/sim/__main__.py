@@ -1,0 +1,37 @@
+"""CLI: python -m doorman_tpu.sim <scenario> [--run-for S] [--seed N]
+[--csv]"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="doorman-tpu simulation")
+    parser.add_argument("scenario", choices=list("1234567"))
+    parser.add_argument("--run-for", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--csv", action="store_true", help="write CSV report")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(levelname)s %(message)s",
+    )
+
+    from doorman_tpu.sim.scenarios import run_scenario
+
+    sim, reporter = run_scenario(
+        args.scenario, args.run_for, args.seed, write_csv=args.csv
+    )
+    summary = reporter.summary()
+    summary["scenario"] = args.scenario
+    summary["simulated_seconds"] = sim.clock.get_time()
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

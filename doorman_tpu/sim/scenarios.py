@@ -1,0 +1,150 @@
+"""The seven reference scenarios (capability parity with reference
+simulation/scenario_*.py), parameterized by a Sim context.
+
+1: convergence — one root job x3 tasks, 5 clients with fluctuating demand.
+2: master loss at T=120, re-election at T=140 (before lease expiry).
+3: master loss at T=120, re-election at T=190 (after lease expiry).
+4: two-level tree (root + one DC job).
+5: three-level tree — root, 3 regions x 3 DCs x 5 clients = 45 clients.
+6: demand spike to 1000 on two clients at T=150.
+7: scenario 5 plus a random mishap every 60s for a simulated hour.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from doorman_tpu.sim.core import Sim
+from doorman_tpu.sim.model import ServerJob, SimClient
+from doorman_tpu.sim.reporter import Reporter
+
+
+def scenario_one(sim: Sim, reporter: Reporter) -> None:
+    job = ServerJob(sim, "root", 0, 3)
+    for _ in range(5):
+        c = SimClient(sim, "client", job)
+        c.add_resource("resource0", 0, 110, 0.1, 10)
+    reporter.schedule("resource0")
+    reporter.set_filename("scenario_one")
+
+
+def _master_loss(sim: Sim, reporter: Reporter, reelect_at: float) -> None:
+    job = ServerJob(sim, "root", 0, 3)
+    for _ in range(5):
+        c = SimClient(sim, "client", job)
+        c.add_resource("resource0", 0, 110, 0.1, 10)
+    sim.scheduler.add_absolute(120, job.lose_master)
+    sim.scheduler.add_absolute(reelect_at, job.trigger_master_election)
+    reporter.schedule("resource0")
+
+
+def scenario_two(sim: Sim, reporter: Reporter) -> None:
+    # Re-election before the 60s leases expire: clients keep capacity.
+    _master_loss(sim, reporter, reelect_at=140)
+    reporter.set_filename("scenario_two")
+
+
+def scenario_three(sim: Sim, reporter: Reporter) -> None:
+    # Re-election after lease expiry: clients drop to zero, then recover.
+    _master_loss(sim, reporter, reelect_at=190)
+    reporter.set_filename("scenario_three")
+
+
+def scenario_four(sim: Sim, reporter: Reporter) -> None:
+    root = ServerJob(sim, "root", 0, 3)
+    dc = ServerJob(sim, "dc", 1, 3, root)
+    for _ in range(5):
+        c = SimClient(sim, "client", dc)
+        c.add_resource("resource0", 0, 110, 0.1, 10)
+    reporter.schedule("resource0")
+    reporter.set_filename("scenario_four")
+
+
+def scenario_five(sim: Sim, reporter: Reporter, num_clients: int = 5) -> None:
+    root = ServerJob(sim, "root", 0, 3)
+    for i in range(1, 4):
+        region = ServerJob(sim, f"region:{i}", 1, 3, root)
+        for j in range(1, 4):
+            dc = ServerJob(sim, f"dc:{i}:{j}", 2, 3, region)
+            for _ in range(num_clients):
+                c = SimClient(sim, f"client:{i}:{j}", dc)
+                c.add_resource("resource0", 0, 15, 0.1, 10)
+    reporter.schedule("resource0")
+    reporter.set_filename("scenario_five")
+
+
+def scenario_six(sim: Sim, reporter: Reporter) -> None:
+    job = ServerJob(sim, "root", 0, 3)
+    clients = []
+    for _ in range(5):
+        c = SimClient(sim, "client", job)
+        c.add_resource("resource0", 0, 50, 0.1, 10)
+        clients.append(c)
+
+    def spike():
+        for c in clients[:2]:
+            c.set_wants("resource0", 1000.0)
+
+    sim.scheduler.add_absolute(150, spike)
+    reporter.schedule("resource0")
+    reporter.set_filename("scenario_six")
+
+
+def scenario_seven(sim: Sim, reporter: Reporter) -> None:
+    scenario_five(sim, reporter)
+    reporter.set_filename("scenario_seven")
+
+    def spike_client():
+        client = sim.random_client()
+        client.set_wants(
+            "resource0", client.get_wants("resource0") + 100
+        )
+        sim.varz.counter("mishap.spike").inc()
+
+    def trigger_election():
+        sim.random_server_job().trigger_master_election()
+        sim.varz.counter("mishap.election").inc()
+
+    def lose_master():
+        job = sim.random_server_job()
+        delay = sim.random.randint(0, 60)
+        job.lose_master()
+        sim.scheduler.add_relative(delay, job.trigger_master_election)
+        sim.varz.counter("mishap.lose_master").inc()
+
+    mishaps = [spike_client, trigger_election, lose_master]
+
+    def random_mishap():
+        sim.scheduler.add_relative(60, random_mishap)
+        sim.random.choice(mishaps)()
+
+    sim.scheduler.add_absolute(60, random_mishap)
+
+
+SCENARIOS: Dict[str, Callable[[Sim, Reporter], None]] = {
+    "1": scenario_one,
+    "2": scenario_two,
+    "3": scenario_three,
+    "4": scenario_four,
+    "5": scenario_five,
+    "6": scenario_six,
+    "7": scenario_seven,
+}
+
+DEFAULT_DURATION: Dict[str, float] = {"7": 3600.0}
+
+
+def run_scenario(name: str, run_for: float | None = None, seed: int = 0,
+                 write_csv: bool = False):
+    """Run one scenario; returns (sim, reporter) for inspection."""
+    sim = Sim(seed=seed)
+    reporter = Reporter(sim)
+    scenario = SCENARIOS[str(name)]
+    scenario(sim, reporter)
+    if not write_csv:
+        reporter.set_filename(None)
+    duration = run_for if run_for is not None else DEFAULT_DURATION.get(
+        str(name), 300.0
+    )
+    sim.scheduler.loop(duration)
+    return sim, reporter

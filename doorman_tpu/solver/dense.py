@@ -60,4 +60,3 @@ def solve_dense(batch: DenseBatch) -> jax.Array:
 
 
 solve_dense_jit = jax.jit(solve_dense)
-solve_dense_donated = jax.jit(solve_dense, donate_argnums=(0,))
