@@ -16,7 +16,7 @@ import logging
 import os
 import socket
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from doorman_tpu.client.connection import Connection
 from doorman_tpu.proto import doorman_pb2 as pb
@@ -72,6 +72,9 @@ class ClientResource:
         if wants <= 0:
             raise ErrInvalidWants(wants)
         self.wants = wants
+        # Trigger an immediate refresh, like the reference's Ask which
+        # re-enqueues the resource (client.go:132-146,242-268).
+        self._client._wake.set()
 
     async def release(self) -> None:
         await self._client.release_resource(self)
@@ -101,6 +104,9 @@ class Client:
         self._wake = asyncio.Event()
         self._closed = False
         self._task: Optional[asyncio.Task] = None
+        # Metrics hook (method, duration_s, error); the obs module's
+        # instrument_client replaces this (reference client.go:87-99).
+        self.on_request: Callable[[str, float, bool], None] = lambda *a: None
 
     @classmethod
     async def connect(cls, addr: str, client_id: Optional[str] = None,
@@ -187,12 +193,22 @@ class Client:
             if res.lease is not None:
                 rr.has.CopyFrom(res.lease)
 
+        start = time.monotonic()
         try:
             out = await self.conn.execute(
                 lambda stub: stub.GetCapacity(request),
             )
+            failed = False
         except Exception:
             log.exception("%s: GetCapacity failed", self.id)
+            failed = True
+        # The hook runs outside the RPC try: a raising user callback must
+        # not be misclassified as an RPC outage (or kill the loop).
+        try:
+            self.on_request("GetCapacity", time.monotonic() - start, failed)
+        except Exception:
+            log.exception("%s: on_request hook raised", self.id)
+        if failed:
             now = time.time()
             for res in self.resources.values():
                 if res.lease is not None and res.expires() < now:

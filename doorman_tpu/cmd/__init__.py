@@ -1,0 +1,9 @@
+"""Binaries: the doorman-tpu server, one-shot client, and interactive
+shell (capability parity with reference go/cmd/).
+
+Run them as modules:
+
+    python -m doorman_tpu.cmd.server --config file:config.yml --port 15000
+    python -m doorman_tpu.cmd.client --server localhost:15000 res0 50
+    python -m doorman_tpu.cmd.shell --server localhost:15000
+"""

@@ -1,0 +1,183 @@
+"""The doorman-tpu server binary.
+
+Capability parity with reference go/cmd/doorman/doorman_server.go:138-248:
+flags (with DOORMAN_* env fallback), etcd or trivial election, YAML config
+from a file (SIGHUP reload) or etcd (watch), TLS, the debug HTTP port with
+/debug/status, /debug/resources, /metrics and /debug/vars, and the
+wait-until-configured gate before serving.
+
+TPU-native addition: --mode batch runs the per-tick batched device solve
+(doorman_tpu.solver.BatchSolver) instead of per-request scalar algorithms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from doorman_tpu.obs import (
+    DebugServer,
+    Registry,
+    add_status_part,
+    instrument_server,
+)
+from doorman_tpu.server import config as config_mod
+from doorman_tpu.server import sources
+from doorman_tpu.server.election import EtcdKV, KVElection, TrivialElection
+from doorman_tpu.server.server import CapacityServer
+from doorman_tpu.utils import flagenv
+
+log = logging.getLogger("doorman.server")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="doorman-server",
+        description="doorman-tpu capacity server",
+    )
+    p.add_argument("--port", type=int, default=15000,
+                   help="port to bind the gRPC service to")
+    p.add_argument("--debug-port", type=int, default=15050,
+                   help="port for the debug HTTP pages "
+                        "(0 picks one, -1 disables)")
+    p.add_argument("--host", default="[::]", help="address to bind")
+    p.add_argument("--server-id", default="",
+                   help="this server's id (default: host:port)")
+    p.add_argument("--parent", default="",
+                   help="parent server address; empty means root")
+    p.add_argument("--config", default="",
+                   help='config source: "file:<path>" or "etcd:<key>"')
+    p.add_argument("--etcd-endpoints", default="",
+                   help="comma-separated etcd endpoints")
+    p.add_argument("--master-election-lock", default="",
+                   help="etcd key for master election (empty: no election)")
+    p.add_argument("--master-delay", type=float, default=10.0,
+                   help="master lease TTL in seconds")
+    p.add_argument("--mode", choices=("immediate", "batch"),
+                   default="immediate",
+                   help="allocation mode: per-request scalar or per-tick "
+                        "batched device solve")
+    p.add_argument("--tick-interval", type=float, default=1.0,
+                   help="batch mode: seconds between device solves")
+    p.add_argument("--minimum-refresh-interval", type=float, default=5.0,
+                   help="floor for client refresh intervals")
+    p.add_argument("--tls-cert", default="", help="TLS certificate file")
+    p.add_argument("--tls-key", default="", help="TLS key file")
+    p.add_argument("--log-level", default="info",
+                   help="debug/info/warning/error")
+    return p
+
+
+async def serve(args: argparse.Namespace, on_started=None) -> None:
+    """Run the server until cancelled. `on_started(server, debug_server)`
+    fires once the gRPC (and debug, if enabled) listeners are bound —
+    tests and embedders use it to learn the ephemeral ports."""
+    etcd_endpoints = [
+        e.strip() for e in args.etcd_endpoints.split(",") if e.strip()
+    ]
+    if args.master_election_lock:
+        election = KVElection(
+            EtcdKV(etcd_endpoints),
+            args.master_election_lock,
+            ttl=args.master_delay,
+        )
+    else:
+        election = TrivialElection()
+
+    server_id = args.server_id or f"{args.host}:{args.port}"
+    server = CapacityServer(
+        server_id,
+        election,
+        parent_addr=args.parent,
+        mode=args.mode,
+        tick_interval=args.tick_interval,
+        minimum_refresh_interval=args.minimum_refresh_interval,
+    )
+
+    port = await server.start(
+        args.port,
+        host=args.host,
+        tls_cert=args.tls_cert or None,
+        tls_key=args.tls_key or None,
+    )
+    log.info("serving gRPC on %s:%d", args.host, port)
+
+    debug = None
+    if args.debug_port >= 0:
+        # A fresh registry per serve() call: repeated serves in one
+        # process must not accumulate collectors for dead servers.
+        registry = instrument_server(server, Registry())
+        debug = DebugServer(port=args.debug_port, registry=registry)
+        debug.add_server(server, asyncio.get_running_loop())
+        add_status_part(
+            "flags",
+            lambda: "<pre>" + "\n".join(sys.argv[1:]) + "</pre>",
+        )
+        debug.start()
+        log.info("debug pages on :%d", debug.port)
+
+    if on_started is not None:
+        on_started(server, debug)
+
+    config_task = None
+    if args.config:
+        # Root servers load config from a source and hot-reload it
+        # (doorman_server.go:204-221). Intermediates self-configure from
+        # parent grants instead (server.go:276-311).
+        source = sources.parse_source(
+            args.config,
+            etcd_endpoints=etcd_endpoints,
+            loop=asyncio.get_running_loop(),
+        )
+
+        async def reload_loop():
+            while True:
+                try:
+                    data = await source()
+                    repo = config_mod.parse_yaml_config(data.decode())
+                    await server.load_config(repo)
+                    log.info("config loaded (%d templates)",
+                             len(repo.resources))
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # A bad or unreadable config version must not kill the
+                    # reload task; keep serving the last good config.
+                    log.exception("config load failed; keeping previous")
+                    await asyncio.sleep(1.0)
+
+        config_task = asyncio.create_task(reload_loop())
+    elif not args.parent:
+        log.error("a root server needs --config")
+        raise SystemExit(2)
+
+    await server.wait_until_configured()
+    log.info("configured; serving")
+    try:
+        await asyncio.Event().wait()  # serve forever
+    finally:
+        if config_task is not None:
+            config_task.cancel()
+        if debug is not None:
+            debug.stop()
+        await server.stop()
+
+
+def main(argv=None) -> None:
+    parser = make_parser()
+    flagenv.populate(parser)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

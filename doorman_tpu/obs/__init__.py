@@ -1,0 +1,28 @@
+"""Observability: metrics registry (Prometheus text exposition) and the
+debug HTTP server with /debug/status, /debug/resources and /metrics.
+
+Capability parity with the reference's go/status/status.go (composable
+status parts), go/cmd/doorman/resourcez.go (per-lease table), and the
+Prometheus instrumentation in go/server/doorman/server.go:92-121,501-517.
+"""
+
+from doorman_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    instrument_server,
+)
+from doorman_tpu.obs.debug import DebugServer, add_status_part
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "default_registry",
+    "instrument_server",
+    "DebugServer",
+    "add_status_part",
+]

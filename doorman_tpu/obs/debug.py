@@ -1,0 +1,251 @@
+"""Debug HTTP server: /debug/status, /debug/resources, /metrics, /healthz.
+
+Capability parity with the reference's composable status page
+(go/status/status.go:129-192 — named template "parts" contributed by any
+subsystem, rendered on one page) and the per-lease resource table
+(go/cmd/doorman/resourcez.go:62-172 — all resources, or one resource's
+leases with ?resource=<id>).
+
+The page handlers run on a plain threaded HTTP server; state is read from
+the owning asyncio loop via run_coroutine_threadsafe when one is attached,
+so reads are atomic with respect to the RPC handlers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import html
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from doorman_tpu.obs import metrics as metrics_mod
+
+__all__ = ["DebugServer", "add_status_part", "status_parts"]
+
+_parts_lock = threading.Lock()
+_parts: Dict[str, Callable[[], str]] = {}
+_start_time = time.time()
+
+
+def add_status_part(name: str, fragment: Callable[[], str]) -> None:
+    """Contribute a named HTML fragment to /debug/status
+    (reference status.go:129-158). The callable runs at page-render time."""
+    with _parts_lock:
+        _parts[name] = fragment
+
+
+def status_parts() -> List[str]:
+    with _parts_lock:
+        items = sorted(_parts.items())
+    out = []
+    for name, fragment in items:
+        try:
+            out.append(f"<h2>{html.escape(name)}</h2>\n{fragment()}")
+        except Exception as e:  # one broken part must not kill the page
+            out.append(
+                f"<h2>{html.escape(name)}</h2>\n"
+                f"<pre>error rendering part: {html.escape(str(e))}</pre>"
+            )
+    return out
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>{title}</title>
+<style>
+body {{ font-family: monospace; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+th, td {{ border: 1px solid #999; padding: 2px 8px; text-align: left; }}
+th {{ background: #eee; }}
+.master {{ color: #070; }} .notmaster {{ color: #a00; }}
+</style></head>
+<body><h1>{title}</h1>
+{body}
+</body></html>"""
+
+
+def _fmt_ts(ts: float) -> str:
+    if ts <= 0:
+        return "-"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+
+
+class DebugServer:
+    """Serves the debug pages for zero or more CapacityServers."""
+
+    def __init__(self, host: str = "", port: int = 0,
+                 registry: Optional[metrics_mod.Registry] = None):
+        self.registry = registry or metrics_mod.default_registry()
+        self._servers: List[tuple] = []  # (capacity_server, loop-or-None)
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host or "", port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def add_server(self, server,
+                   loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        """Expose a CapacityServer on the pages (resourcez.go:54). If a
+        loop is given, its state is snapshotted on that loop."""
+        self._servers.append((server, loop))
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="debug-http", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+
+    def _call(self, loop, fn):
+        """Run fn on the server's asyncio loop (atomic w.r.t. RPC handlers)
+        when one is attached and running; else directly."""
+        if loop is not None and loop.is_running():
+            async def grab():
+                return fn()
+
+            return asyncio.run_coroutine_threadsafe(grab(), loop).result(5)
+        return fn()
+
+    def _snapshot(self, server, loop) -> dict:
+        return self._call(loop, server.status)
+
+    def _statuses(self) -> List[dict]:
+        return [self._snapshot(s, l) for s, l in self._servers]
+
+    def _status_page(self) -> str:
+        sections = []
+        for st in self._statuses():
+            cls = "master" if st["is_master"] else "notmaster"
+            rows = "".join(
+                f"<tr><td>{html.escape(rid)}</td>"
+                f"<td>{r['capacity']:g}</td>"
+                f"<td>{html.escape(r['algorithm'])}</td>"
+                f"<td>{r['sum_has']:g}</td>"
+                f"<td>{r['sum_wants']:g}</td>"
+                f"<td>{r['count']}</td>"
+                f"<td>{'yes' if r['in_learning_mode'] else 'no'}</td></tr>"
+                for rid, r in sorted(st["resources"].items())
+            )
+            sections.append(
+                f"<h2>server {html.escape(st['id'])}</h2>"
+                f"<p class={cls!r}>is_master: {st['is_master']}</p>"
+                f"<p>current master: "
+                f"{html.escape(st['current_master'] or '(unknown)')}<br>"
+                f"election: {html.escape(st['election'])}<br>"
+                f"mode: {html.escape(st['mode'])}</p>"
+                f"<table><tr><th>resource</th><th>capacity</th>"
+                f"<th>algorithm</th><th>has</th>"
+                f"<th>wants</th><th>subclients</th><th>learning</th></tr>"
+                f"{rows}</table>"
+                f"<h3>config</h3><pre>{html.escape(st['config'])}</pre>"
+            )
+        uptime = time.time() - _start_time
+        body = (
+            f"<p>uptime: {uptime:.0f}s</p>"
+            + "".join(sections)
+            + "".join(status_parts())
+            + "<p><a href='/debug/resources'>resources</a> | "
+            "<a href='/metrics'>metrics</a> | "
+            "<a href='/debug/vars'>vars</a></p>"
+        )
+        return _PAGE.format(title="/debug/status", body=body)
+
+    def _resources_page(self, only: Optional[str]) -> str:
+        sections = []
+        for (server, loop), st in zip(self._servers, self._statuses()):
+            for rid in sorted(st["resources"]):
+                if only is not None and rid != only:
+                    continue
+                lease_st = self._call(
+                    loop, lambda: server.resource_lease_status(rid)
+                )
+                if lease_st is None:
+                    continue
+                rows = "".join(
+                    f"<tr><td>{html.escape(cs.client_id)}</td>"
+                    f"<td>{cs.lease.has:g}</td>"
+                    f"<td>{cs.lease.wants:g}</td>"
+                    f"<td>{cs.lease.subclients}</td>"
+                    f"<td>{_fmt_ts(cs.lease.expiry)}</td></tr>"
+                    for cs in lease_st.leases
+                )
+                sections.append(
+                    f"<h2>{html.escape(rid)} @ {html.escape(st['id'])}</h2>"
+                    f"<p>sum_has: {lease_st.sum_has:g} / "
+                    f"sum_wants: {lease_st.sum_wants:g}</p>"
+                    f"<table><tr><th>client</th><th>has</th><th>wants</th>"
+                    f"<th>subclients</th><th>expires</th></tr>"
+                    f"{rows}</table>"
+                )
+        if not sections:
+            sections.append("<p>no resources</p>")
+        return _PAGE.format(
+            title="/debug/resources", body="".join(sections)
+        )
+
+    def _vars(self) -> str:
+        """expvar-style JSON snapshot (the reference blank-imports expvar,
+        doorman_server.go:43-45)."""
+        return json.dumps(
+            {
+                "uptime_seconds": time.time() - _start_time,
+                "servers": self._statuses(),
+            },
+            indent=2,
+            default=str,
+        )
+
+    def _make_handler(self):
+        debug = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/metrics":
+                        body, ctype = (
+                            debug.registry.expose(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif url.path in ("/", "/debug/status"):
+                        body, ctype = debug._status_page(), "text/html"
+                    elif url.path == "/debug/resources":
+                        q = parse_qs(url.query)
+                        only = q.get("resource", [None])[0]
+                        body, ctype = (
+                            debug._resources_page(only),
+                            "text/html",
+                        )
+                    elif url.path == "/debug/vars":
+                        body, ctype = debug._vars(), "application/json"
+                    elif url.path == "/healthz":
+                        body, ctype = "ok\n", "text/plain"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:
+                    self.send_error(500, str(e))
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        return Handler

@@ -93,6 +93,7 @@ class CapacityServer(CapacityServicer):
         self._tasks: List[asyncio.Task] = []
         self._solver = None
         self._grpc_server: Optional[grpc.aio.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.port: Optional[int] = None
 
         # Metrics hooks; the metrics module replaces these when enabled.
@@ -102,11 +103,29 @@ class CapacityServer(CapacityServicer):
     # Lifecycle
     # ------------------------------------------------------------------
 
-    async def start(self, port: int = 0, host: str = "[::]") -> int:
-        """Start serving gRPC; returns the bound port."""
+    async def start(
+        self,
+        port: int = 0,
+        host: str = "[::]",
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+    ) -> int:
+        """Start serving gRPC; returns the bound port. Passing a cert/key
+        pair serves TLS (reference doorman_server.go:171-177)."""
+        self._loop = asyncio.get_running_loop()
         server = grpc.aio.server()
         add_capacity_servicer(server, self)
-        self.port = server.add_insecure_port(f"{host}:{port}")
+        if tls_cert or tls_key:
+            if not (tls_cert and tls_key):
+                raise ValueError("tls_cert and tls_key must both be set")
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            with open(tls_cert, "rb") as f:
+                cert = f.read()
+            creds = grpc.ssl_server_credentials([(key, cert)])
+            self.port = server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            self.port = server.add_insecure_port(f"{host}:{port}")
         await server.start()
         self._grpc_server = server
 

@@ -1,0 +1,172 @@
+"""Config sources: blocking "next config version" abstraction.
+
+Capability parity with reference go/configuration/configuration.go: a
+Source is an async callable returning the next version of the raw config
+bytes — LocalFile re-reads on SIGHUP (with an initial self-signal so the
+first call returns immediately, configuration.go:31-53), Etcd gets then
+watches a key (configuration.go:56-105), and parse_source dispatches on a
+"file:" / "etcd:" prefix (configuration.go:109-121).
+
+The etcd source is gated: this image has no etcd client library, so it
+talks the etcd v3 HTTP/JSON gateway via urllib in an executor thread, and
+raises a clear error at construction if the endpoint list is empty.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import signal
+import urllib.request
+from typing import Awaitable, Callable, List, Optional
+
+from doorman_tpu.utils.backoff import MIN_BACKOFF, MAX_BACKOFF, backoff
+
+log = logging.getLogger(__name__)
+
+# A Source, awaited repeatedly, blocks until a new config version exists
+# and returns its bytes (configuration.go:21-29).
+Source = Callable[[], Awaitable[bytes]]
+
+
+def local_file(path: str,
+               loop: Optional[asyncio.AbstractEventLoop] = None) -> Source:
+    """Re-reads `path` every time SIGHUP arrives; the first call reads
+    immediately (the reference self-sends SIGHUP at setup,
+    configuration.go:36)."""
+    event = asyncio.Event()
+    event.set()  # initial read
+    loop = loop or asyncio.get_event_loop()
+    try:
+        loop.add_signal_handler(signal.SIGHUP, event.set)
+    except (NotImplementedError, RuntimeError, ValueError):
+        # Non-unix platform, or the loop runs off the main thread
+        # (add_signal_handler raises ValueError there).
+        log.warning("SIGHUP reload unavailable; config loads once")
+
+    async def source() -> bytes:
+        await event.wait()
+        event.clear()
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: open(path, "rb").read()
+        )
+
+    return source
+
+
+class _EtcdGateway:
+    """Minimal etcd v3 HTTP/JSON gateway client (get + blocking watch)."""
+
+    def __init__(self, endpoints: List[str]):
+        if not endpoints:
+            raise ValueError("etcd source needs at least one endpoint")
+        self.endpoints = [
+            e if "://" in e else f"http://{e}" for e in endpoints
+        ]
+
+    def _post(self, path: str, payload: dict, timeout: float = 30.0) -> dict:
+        last_err: Exception = RuntimeError("no endpoints")
+        for endpoint in self.endpoints:
+            try:
+                req = urllib.request.Request(
+                    endpoint + path,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read().decode())
+            except Exception as e:  # try the next endpoint
+                last_err = e
+        raise last_err
+
+    def get(self, key: str) -> Optional[bytes]:
+        out = self._post(
+            "/v3/kv/range",
+            {"key": base64.b64encode(key.encode()).decode()},
+        )
+        kvs = out.get("kvs", [])
+        if not kvs:
+            return None
+        return base64.b64decode(kvs[0]["value"])
+
+    def wait_for_change(self, key: str, timeout: float = 60.0) -> None:
+        """Block until the key changes (or timeout); one-shot watch.
+
+        /v3/watch is a never-closing newline-delimited JSON stream: the
+        first frame acknowledges watch creation, each later frame carries
+        events. Read frame-by-frame and return on the first event frame;
+        on any error or timeout, degrade to polling."""
+        payload = {
+            "create_request": {
+                "key": base64.b64encode(key.encode()).decode()
+            }
+        }
+        for endpoint in self.endpoints:
+            try:
+                req = urllib.request.Request(
+                    endpoint + "/v3/watch",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            return  # stream closed
+                        try:
+                            frame = json.loads(line.decode())
+                        except ValueError:
+                            return
+                        result = frame.get("result", frame)
+                        if result.get("events"):
+                            return  # the key changed
+                        # else: the creation ack; keep waiting
+            except Exception:
+                continue  # next endpoint, or fall through to polling
+        return
+
+
+def etcd(key: str, endpoints: List[str]) -> Source:
+    """Gets `key`, then blocks on a watch for each subsequent version,
+    retrying with backoff on errors (configuration.go:56-105)."""
+    gateway = _EtcdGateway(endpoints)
+    state = {"first": True, "retries": 0}
+
+    async def source() -> bytes:
+        loop = asyncio.get_event_loop()
+        while True:
+            if not state["first"]:
+                await loop.run_in_executor(
+                    None, gateway.wait_for_change, key
+                )
+            try:
+                value = await loop.run_in_executor(None, gateway.get, key)
+            except Exception:
+                log.exception("etcd get %r failed", key)
+                value = None
+            if value is not None:
+                state["first"] = False
+                state["retries"] = 0
+                return value
+            await asyncio.sleep(
+                backoff(MIN_BACKOFF, MAX_BACKOFF, state["retries"])
+            )
+            state["retries"] += 1
+
+    return source
+
+
+def parse_source(text: str, etcd_endpoints: Optional[List[str]] = None,
+                 loop: Optional[asyncio.AbstractEventLoop] = None) -> Source:
+    """Dispatch on "file:<path>" or "etcd:<key>" (configuration.go:109)."""
+    kind, sep, path = text.partition(":")
+    if not sep:
+        raise ValueError(f"config source needs a 'file:'/'etcd:' prefix: "
+                         f"{text!r}")
+    if kind == "file":
+        return local_file(path, loop=loop)
+    if kind == "etcd":
+        return etcd(path, etcd_endpoints or [])
+    raise ValueError(f"unknown config source kind {kind!r} in {text!r}")

@@ -1,0 +1,184 @@
+"""CLI and config-source tests: the server binary's serve() wiring (config
+reload from file, debug pages), the one-shot client, the shell REPL
+commands, and the SIGHUP-driven file source (capability parity with
+reference configuration_test.go and the doorman_shell flow)."""
+
+import asyncio
+import os
+import signal
+import urllib.request
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.cmd import client as client_cmd
+from doorman_tpu.cmd import server as server_cmd
+from doorman_tpu.cmd.shell import Multiclient, eval_line
+from doorman_tpu.server import sources
+
+CONFIG = """
+resources:
+- identifier_glob: "*"
+  capacity: 90
+  algorithm: {kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
+CONFIG_V2 = CONFIG.replace("90", "150")
+
+
+def test_parse_source_rejects_garbage():
+    with pytest.raises(ValueError):
+        sources.parse_source("no-prefix")
+    with pytest.raises(ValueError):
+        sources.parse_source("zookeeper:/x")
+    with pytest.raises(ValueError):
+        sources.parse_source("etcd:/key", etcd_endpoints=[])
+
+
+def test_local_file_sighup_reload(tmp_path):
+    path = tmp_path / "config.yml"
+    path.write_text("v1")
+
+    async def body():
+        source = sources.local_file(str(path))
+        assert await asyncio.wait_for(source(), 5) == b"v1"
+        path.write_text("v2")
+        next_read = asyncio.create_task(source())
+        await asyncio.sleep(0.05)
+        assert not next_read.done()  # blocks until SIGHUP
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert await asyncio.wait_for(next_read, 5) == b"v2"
+
+    asyncio.run(body())
+
+
+def test_server_flag_parser_env_fallback(monkeypatch):
+    monkeypatch.setenv("DOORMAN_PORT", "4242")
+    parser = server_cmd.make_parser()
+    from doorman_tpu.utils import flagenv
+
+    flagenv.populate(parser)
+    args = parser.parse_args([])
+    assert args.port == 4242
+    assert args.mode == "immediate"
+
+
+async def _start_serve(args):
+    """Run serve() as a task; returns (task, server, debug) once bound and
+    configured."""
+    started = asyncio.get_running_loop().create_future()
+    task = asyncio.create_task(
+        server_cmd.serve(args, on_started=lambda s, d: started.set_result((s, d)))
+    )
+    server, debug = await asyncio.wait_for(started, 10)
+    await asyncio.wait_for(server.wait_until_configured(), 10)
+    for _ in range(100):  # wait for the election callbacks to land
+        if server.is_master:
+            break
+        await asyncio.sleep(0.05)
+    return task, server, debug
+
+
+async def _stop(task):
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+def test_server_binary_end_to_end(tmp_path):
+    """Start serve() with a file config, drive it with the one-shot client
+    CLI and the shell, then reload config via SIGHUP."""
+    config_path = tmp_path / "config.yml"
+    config_path.write_text(CONFIG)
+
+    async def body():
+        parser = server_cmd.make_parser()
+        args = parser.parse_args(
+            [
+                "--port", "0",
+                "--host", "127.0.0.1",
+                "--debug-port", "0",
+                "--config", f"file:{config_path}",
+                "--server-id", "cmd-test",
+                "--minimum-refresh-interval", "0",
+            ]
+        )
+        task, server, _ = await _start_serve(args)
+        addr = f"127.0.0.1:{server.port}"
+        server.current_master = addr
+
+        # One-shot client.
+        rc = await client_cmd.run(
+            client_cmd.make_parser().parse_args(
+                ["--server", addr, "--client-id", "oneshot", "r0", "30"]
+            )
+        )
+        assert rc == 0
+
+        # Shell flow.
+        mc = Multiclient(addr)
+        out = await eval_line(mc, "get alice r0 50")
+        assert "alice: r0 = " in out
+        out = await eval_line(mc, "get bob r0 60")
+        assert "bob: r0 = " in out
+        out = await eval_line(mc, "show all")
+        assert "alice" in out and "bob" in out
+        assert await eval_line(mc, "master")
+        assert "unknown command" in (await eval_line(mc, "frobnicate"))
+        out = await eval_line(mc, "release alice r0")
+        assert "released" in out
+        await mc.close()
+
+        # SIGHUP config reload: capacity 90 -> 150.
+        config_path.write_text(CONFIG_V2)
+        os.kill(os.getpid(), signal.SIGHUP)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            res = server.resources.get("r0")
+            if res is not None and res.capacity == 150:
+                break
+        else:
+            raise AssertionError("config reload did not land")
+
+        await _stop(task)
+
+    asyncio.run(body())
+
+
+def test_debug_port_serves_metrics(tmp_path):
+    config_path = tmp_path / "config.yml"
+    config_path.write_text(CONFIG)
+
+    async def body():
+        parser = server_cmd.make_parser()
+        args = parser.parse_args(
+            [
+                "--port", "0",
+                "--host", "127.0.0.1",
+                "--debug-port", "0",
+                "--config", f"file:{config_path}",
+                "--server-id", "cmd-debug-test",
+            ]
+        )
+        task, _, debug = await _start_serve(args)
+        assert debug is not None
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{debug.port}{path}", timeout=5
+            ) as resp:
+                return resp.read().decode()
+
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, fetch, "/metrics")
+        assert "doorman_server_is_master" in text
+        page = await loop.run_in_executor(None, fetch, "/debug/status")
+        assert "cmd-debug-test" in page
+
+        await _stop(task)
+
+    asyncio.run(body())
