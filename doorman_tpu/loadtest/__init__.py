@@ -1,0 +1,15 @@
+"""Load testing: recipe-driven QPS schedules and a self-contained
+loadtest (target server + doorman-limited workers).
+
+Capability parity with reference go/client/recipe/recipe.go and
+doc/loadtest/docker/{client,target}.
+"""
+
+from doorman_tpu.loadtest.recipe import (
+    Recipe,
+    RecipeError,
+    WorkerState,
+    parse_recipes,
+)
+
+__all__ = ["Recipe", "RecipeError", "WorkerState", "parse_recipes"]

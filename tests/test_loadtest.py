@@ -1,0 +1,182 @@
+"""Recipe parser/schedule tests (capability parity with reference
+go/client/recipe/recipe.go) and a short end-to-end loadtest: server +
+target + recipe-driven workers over real gRPC/TCP on loopback."""
+
+import asyncio
+import math
+import random
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.loadtest import RecipeError, parse_recipes
+from doorman_tpu.loadtest.target import Target, ping
+from doorman_tpu.loadtest.worker import run_worker
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_parse_recipes_counts_and_base():
+    workers = parse_recipes("5x100+sin(30),2x10+constant_increase(1)")
+    assert len(workers) == 7
+    assert workers[0].recipe.name == "sin"
+    assert workers[0].current_qps == 100.0
+    assert workers[5].recipe.name == "constant_increase"
+    assert workers[5].current_qps == 10.0
+    # Workers of one recipe share the (frozen) recipe object.
+    assert workers[0].recipe is workers[4].recipe
+
+
+def test_parse_recipes_rejects_garbage():
+    for bad in ["", "5x100", "5x100+nope(1)", "x100+sin(1)",
+                "5x100+sin(1,2)", "5x100+sin()"]:
+        with pytest.raises(RecipeError):
+            parse_recipes(bad)
+
+
+def test_constant_increase_schedule():
+    clock = FakeClock()
+    (w,) = parse_recipes(
+        "1x100+constant_increase(5)", interval=60, reset=3600, clock=clock
+    )
+    assert not w.interval_expired()  # nothing elapsed
+    clock.now = 61
+    assert w.interval_expired()
+    assert w.current_qps == 105.0 and w.old_qps == 100.0
+    assert not w.interval_expired()  # same interval
+    clock.now = 122
+    assert w.interval_expired()
+    assert w.current_qps == 110.0
+
+
+def test_reset_snaps_back_to_base():
+    clock = FakeClock()
+    (w,) = parse_recipes(
+        "1x10+constant_increase(10)", interval=1, reset=5, clock=clock
+    )
+    for t in (1.1, 2.2, 3.3):
+        clock.now = t
+        assert w.interval_expired()
+    assert w.current_qps == 40.0
+    clock.now = 5.5  # reset elapsed
+    assert w.interval_expired()
+    assert w.current_qps == 10.0
+    assert w.reset_count == 1
+
+
+def test_sin_and_inc_sin_shapes():
+    clock = FakeClock()
+    reset = 100.0
+    (s,) = parse_recipes("1x0+sin(80)", interval=1, reset=reset, clock=clock)
+    (i,) = parse_recipes(
+        "1x0+inc_sin(80)", interval=1, reset=reset, clock=clock
+    )
+    clock.now = 50.0  # mid-reset: sin(pi/2) = 1
+    assert s.interval_expired()
+    assert s.current_qps == pytest.approx(80.0)
+    assert i.interval_expired()
+    assert i.current_qps == pytest.approx(0.0)  # no reset yet: factor 0
+    clock.now = 101.0
+    assert i.interval_expired()  # the reset: back to base
+    clock.now = 151.0  # mid second cycle, reset_count == 1
+    assert i.interval_expired()
+    assert i.current_qps == pytest.approx(
+        1 * 80.0 * math.sin(math.pi * 50.0 / reset)
+    )
+
+
+def test_random_change_bounded():
+    clock = FakeClock()
+    (w,) = parse_recipes(
+        "1x100+random_change(20)", interval=1, reset=10_000, clock=clock,
+        rng=random.Random(3),
+    )
+    for k in range(50):
+        clock.now = (k + 1) * 1.01
+        assert w.interval_expired()
+        assert 80.0 <= w.current_qps <= 120.0
+
+
+def test_target_counts_requests():
+    async def body():
+        target = Target()
+        port = await target.start(0)
+        call, close = await ping("127.0.0.1", port)
+        for _ in range(7):
+            await call()
+        assert target.requests == 7
+        await close()
+        await target.stop()
+
+    asyncio.run(body())
+
+
+def test_loadtest_end_to_end():
+    """Two recipe workers against a real server and target: requests flow
+    and the server sees the demand."""
+    config = """
+resources:
+- identifier_glob: "*"
+  capacity: 1000
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60,
+              refresh_interval: 1, learning_mode_duration: 0}
+"""
+
+    async def body():
+        server = CapacityServer(
+            "lt-server", TrivialElection(), minimum_refresh_interval=0.0
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(parse_yaml_config(config))
+        await asyncio.sleep(0)
+        server.current_master = f"127.0.0.1:{port}"
+
+        target = Target()
+        tport = await target.start(0)
+
+        workers = parse_recipes(
+            "2x50+constant_increase(0)", interval=3600, reset=7200
+        )
+        stats = {}
+        tasks = [
+            asyncio.create_task(
+                run_worker(
+                    i, w, f"127.0.0.1:{port}", f"lt-{i}", "shared",
+                    f"127.0.0.1:{tport}", stats,
+                    minimum_refresh_interval=0.0,
+                )
+            )
+            for i, w in enumerate(workers)
+        ]
+        # ~1.5s of load at 2x50 qps should produce a healthy batch of
+        # requests through the limiter.
+        await asyncio.sleep(1.5)
+        res = server.resources.get("shared")
+        assert res is not None
+        # Demand visible while workers hold leases (released on cancel).
+        assert res.store.sum_wants == pytest.approx(100.0)
+
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        assert target.requests > 20, target.requests
+
+        await target.stop()
+        await server.stop()
+
+    asyncio.run(body())
