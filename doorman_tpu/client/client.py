@@ -95,10 +95,15 @@ class Client:
         client_id: Optional[str] = None,
         *,
         minimum_refresh_interval: float = 5.0,
+        tls: bool = False,
+        tls_ca: Optional[str] = None,
     ):
         self.id = client_id or _default_client_id()
         self.conn = Connection(
-            addr, minimum_refresh_interval=minimum_refresh_interval
+            addr,
+            minimum_refresh_interval=minimum_refresh_interval,
+            tls=tls,
+            tls_ca=tls_ca,
         )
         self.resources: Dict[str, ClientResource] = {}
         self._wake = asyncio.Event()

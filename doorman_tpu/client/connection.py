@@ -44,12 +44,27 @@ class Connection:
         minimum_refresh_interval: float = 5.0,
         max_retries: Optional[int] = None,
         grpc_options: Optional[list] = None,
+        tls: bool = False,
+        tls_ca: Optional[str] = None,
     ):
+        """`tls=True` dials with TLS using the system roots; `tls_ca` (a
+        PEM file path) pins the root certificate and implies TLS — the
+        client side of the server's --tls-cert/--tls-key
+        (doorman_server.go:164-168 dial options)."""
         self.addr = addr
         self.current_master = ""
         self.minimum_refresh_interval = minimum_refresh_interval
         self.max_retries = max_retries
         self._grpc_options = grpc_options
+        self._credentials: Optional[grpc.ChannelCredentials] = None
+        if tls or tls_ca:
+            root_certificates = None
+            if tls_ca:
+                with open(tls_ca, "rb") as f:
+                    root_certificates = f.read()
+            self._credentials = grpc.ssl_channel_credentials(
+                root_certificates=root_certificates
+            )
         self._channel: Optional[grpc.aio.Channel] = None
         self.stub: Optional[CapacityStub] = None
 
@@ -59,9 +74,14 @@ class Connection:
     async def _connect(self, addr: str) -> None:
         await self.close()
         log.info("connecting to %s", addr)
-        self._channel = grpc.aio.insecure_channel(
-            addr, options=self._grpc_options
-        )
+        if self._credentials is not None:
+            self._channel = grpc.aio.secure_channel(
+                addr, self._credentials, options=self._grpc_options
+            )
+        else:
+            self._channel = grpc.aio.insecure_channel(
+                addr, options=self._grpc_options
+            )
         self.stub = CapacityStub(self._channel)
         self.current_master = addr
 

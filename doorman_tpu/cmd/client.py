@@ -26,6 +26,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="client id (default: hostname:pid)")
     p.add_argument("--timeout", type=float, default=30.0,
                    help="seconds to wait for a grant")
+    p.add_argument("--tls", action="store_true",
+                   help="dial with TLS (system roots)")
+    p.add_argument("--tls-ca", default="",
+                   help="PEM root certificate to trust (implies TLS)")
     p.add_argument("resource_id", help="resource to ask capacity for")
     p.add_argument("wants", type=float, help="how much capacity to ask for")
     return p
@@ -33,7 +37,8 @@ def make_parser() -> argparse.ArgumentParser:
 
 async def run(args: argparse.Namespace) -> int:
     client = await Client.connect(
-        args.server, args.client_id or None, minimum_refresh_interval=0.0
+        args.server, args.client_id or None, minimum_refresh_interval=0.0,
+        tls=args.tls, tls_ca=args.tls_ca or None,
     )
     try:
         res = await client.resource(args.resource_id, args.wants)

@@ -65,6 +65,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="floor for client refresh intervals")
     p.add_argument("--tls-cert", default="", help="TLS certificate file")
     p.add_argument("--tls-key", default="", help="TLS key file")
+    p.add_argument("--parent-tls", action="store_true",
+                   help="dial the parent with TLS (system roots)")
+    p.add_argument("--parent-tls-ca", default="",
+                   help="PEM root certificate for the parent (implies TLS)")
     p.add_argument("--log-level", default="info",
                    help="debug/info/warning/error")
     return p
@@ -91,6 +95,8 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         server_id,
         election,
         parent_addr=args.parent,
+        parent_tls=args.parent_tls,
+        parent_tls_ca=args.parent_tls_ca or None,
         mode=args.mode,
         tick_interval=args.tick_interval,
         minimum_refresh_interval=args.minimum_refresh_interval,

@@ -33,8 +33,10 @@ class Multiclient:
     """A set of emulated clients keyed by name
     (doorman_shell.go:88-190)."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, tls: bool = False, tls_ca: str = ""):
         self.addr = addr
+        self.tls = tls
+        self.tls_ca = tls_ca or None
         self.clients: Dict[str, Client] = {}
         self.resources: Dict[str, Dict[str, ClientResource]] = {}
 
@@ -42,7 +44,8 @@ class Multiclient:
         client = self.clients.get(name)
         if client is None:
             client = await Client.connect(
-                self.addr, name, minimum_refresh_interval=0.0
+                self.addr, name, minimum_refresh_interval=0.0,
+                tls=self.tls, tls_ca=self.tls_ca,
             )
             self.clients[name] = client
             self.resources[name] = {}
@@ -122,8 +125,8 @@ async def eval_line(mc: Multiclient, line: str) -> str:
     return f"unknown command: {line!r} (try 'help')"
 
 
-async def repl(addr: str) -> None:
-    mc = Multiclient(addr)
+async def repl(addr: str, tls: bool = False, tls_ca: str = "") -> None:
+    mc = Multiclient(addr, tls=tls, tls_ca=tls_ca)
     loop = asyncio.get_running_loop()
     try:
         while True:
@@ -150,11 +153,15 @@ def main(argv=None) -> None:
     )
     p.add_argument("--server", default="localhost:15000",
                    help="doorman server address")
+    p.add_argument("--tls", action="store_true",
+                   help="dial with TLS (system roots)")
+    p.add_argument("--tls-ca", default="",
+                   help="PEM root certificate to trust (implies TLS)")
     flagenv.populate(p)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.WARNING)
     try:
-        asyncio.run(repl(args.server))
+        asyncio.run(repl(args.server, tls=args.tls, tls_ca=args.tls_ca))
     except KeyboardInterrupt:
         pass
     print("bye", file=sys.stderr)

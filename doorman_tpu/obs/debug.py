@@ -111,12 +111,7 @@ class DebugServer:
     def _call(self, loop, fn):
         """Run fn on the server's asyncio loop (atomic w.r.t. RPC handlers)
         when one is attached and running; else directly."""
-        if loop is not None and loop.is_running():
-            async def grab():
-                return fn()
-
-            return asyncio.run_coroutine_threadsafe(grab(), loop).result(5)
-        return fn()
+        return metrics_mod.call_on_loop(loop, fn)
 
     def _snapshot(self, server, loop) -> dict:
         return self._call(loop, server.status)

@@ -32,6 +32,19 @@ __all__ = [
 
 LabelValues = Tuple[str, ...]
 
+
+def call_on_loop(loop, fn, timeout: float = 5.0):
+    """Run fn on an asyncio loop from another thread (atomic w.r.t. the
+    loop's coroutines) when the loop is running; else call directly."""
+    if loop is not None and loop.is_running():
+        import asyncio
+
+        async def grab():
+            return fn()
+
+        return asyncio.run_coroutine_threadsafe(grab(), loop).result(timeout)
+    return fn()
+
 DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
@@ -297,20 +310,13 @@ def instrument_server(server, registry: Optional[Registry] = None) -> Registry:
     def collect() -> Iterable[Metric]:
         # Snapshot live server state on its asyncio loop when one is
         # running (atomic w.r.t. RPC handlers), mirroring the debug pages.
-        loop = getattr(server, "_loop", None)
-        if loop is not None and loop.is_running():
-            import asyncio
-
-            async def grab():
-                return _collect_now(server)
-
-            try:
-                return asyncio.run_coroutine_threadsafe(
-                    grab(), loop
-                ).result(5)
-            except Exception:
-                return []
-        return _collect_now(server)
+        try:
+            return call_on_loop(
+                getattr(server, "_loop", None),
+                lambda: _collect_now(server),
+            )
+        except Exception:
+            return []
 
     registry.add_collector(collect)
     return registry

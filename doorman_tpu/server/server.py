@@ -67,6 +67,8 @@ class CapacityServer(CapacityServicer):
         election: Election,
         *,
         parent_addr: str = "",
+        parent_tls: bool = False,
+        parent_tls_ca: Optional[str] = None,
         mode: str = "immediate",  # "immediate" | "batch"
         tick_interval: float = 1.0,
         minimum_refresh_interval: float = 5.0,
@@ -89,6 +91,8 @@ class CapacityServer(CapacityServicer):
         self.is_configured = asyncio.Event()
 
         self.parent_addr = parent_addr
+        self.parent_tls = parent_tls
+        self.parent_tls_ca = parent_tls_ca
         self._parent_conn = None  # created lazily (import cycle + testing)
         self._tasks: List[asyncio.Task] = []
         self._solver = None
@@ -435,6 +439,8 @@ class CapacityServer(CapacityServicer):
             self._parent_conn = Connection(
                 self.parent_addr,
                 minimum_refresh_interval=self.minimum_refresh_interval,
+                tls=self.parent_tls,
+                tls_ca=self.parent_tls_ca,
             )
         request = self._build_server_capacity_request()
         try:

@@ -20,6 +20,7 @@ import json
 import logging
 import signal
 import urllib.request
+import weakref
 from typing import Awaitable, Callable, List, Optional
 
 from doorman_tpu.utils.backoff import MIN_BACKOFF, MAX_BACKOFF, backoff
@@ -31,6 +32,17 @@ log = logging.getLogger(__name__)
 Source = Callable[[], Awaitable[bytes]]
 
 
+# All live file sources share one SIGHUP handler that wakes every one of
+# them — a per-source add_signal_handler would silently clobber the
+# previous source's handler. WeakSet so abandoned sources get collected.
+_sighup_events: "weakref.WeakSet[asyncio.Event]" = weakref.WeakSet()
+
+
+def _on_sighup() -> None:
+    for event in list(_sighup_events):
+        event.set()
+
+
 def local_file(path: str,
                loop: Optional[asyncio.AbstractEventLoop] = None) -> Source:
     """Re-reads `path` every time SIGHUP arrives; the first call reads
@@ -39,8 +51,9 @@ def local_file(path: str,
     event = asyncio.Event()
     event.set()  # initial read
     loop = loop or asyncio.get_event_loop()
+    _sighup_events.add(event)
     try:
-        loop.add_signal_handler(signal.SIGHUP, event.set)
+        loop.add_signal_handler(signal.SIGHUP, _on_sighup)
     except (NotImplementedError, RuntimeError, ValueError):
         # Non-unix platform, or the loop runs off the main thread
         # (add_signal_handler raises ValueError there).
@@ -132,12 +145,12 @@ def etcd(key: str, endpoints: List[str]) -> Source:
     """Gets `key`, then blocks on a watch for each subsequent version,
     retrying with backoff on errors (configuration.go:56-105)."""
     gateway = _EtcdGateway(endpoints)
-    state = {"first": True, "retries": 0}
+    state = {"last": None, "retries": 0}
 
     async def source() -> bytes:
         loop = asyncio.get_event_loop()
         while True:
-            if not state["first"]:
+            if state["last"] is not None:
                 await loop.run_in_executor(
                     None, gateway.wait_for_change, key
                 )
@@ -146,10 +159,13 @@ def etcd(key: str, endpoints: List[str]) -> Source:
             except Exception:
                 log.exception("etcd get %r failed", key)
                 value = None
-            if value is not None:
-                state["first"] = False
+            if value is not None and value != state["last"]:
+                state["last"] = value
                 state["retries"] = 0
                 return value
+            # Missing key, or the watch degraded (error/timeout) and the
+            # value is unchanged: back off instead of busy-reloading the
+            # same config.
             await asyncio.sleep(
                 backoff(MIN_BACKOFF, MAX_BACKOFF, state["retries"])
             )

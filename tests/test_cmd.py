@@ -54,6 +54,30 @@ def test_local_file_sighup_reload(tmp_path):
     asyncio.run(body())
 
 
+def test_two_local_file_sources_both_reload(tmp_path):
+    """Two live file sources share the SIGHUP handler; one must not
+    clobber the other."""
+    a, b = tmp_path / "a.yml", tmp_path / "b.yml"
+    a.write_text("a1")
+    b.write_text("b1")
+
+    async def body():
+        src_a = sources.local_file(str(a))
+        src_b = sources.local_file(str(b))
+        assert await asyncio.wait_for(src_a(), 5) == b"a1"
+        assert await asyncio.wait_for(src_b(), 5) == b"b1"
+        a.write_text("a2")
+        b.write_text("b2")
+        next_a = asyncio.create_task(src_a())
+        next_b = asyncio.create_task(src_b())
+        await asyncio.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert await asyncio.wait_for(next_a, 5) == b"a2"
+        assert await asyncio.wait_for(next_b, 5) == b"b2"
+
+    asyncio.run(body())
+
+
 def test_server_flag_parser_env_fallback(monkeypatch):
     monkeypatch.setenv("DOORMAN_PORT", "4242")
     parser = server_cmd.make_parser()
