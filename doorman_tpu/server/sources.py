@@ -34,13 +34,18 @@ Source = Callable[[], Awaitable[bytes]]
 
 # All live file sources share one SIGHUP handler that wakes every one of
 # them — a per-source add_signal_handler would silently clobber the
-# previous source's handler. WeakSet so abandoned sources get collected.
-_sighup_events: "weakref.WeakSet[asyncio.Event]" = weakref.WeakSet()
+# previous source's handler. Each event is woken via its OWN loop
+# (call_soon_threadsafe): the handler runs on the main thread's loop, but
+# a source may live on a loop in another thread, and Event.set() is not
+# thread-safe. WeakKeyDictionary so abandoned sources get collected.
+_sighup_events: "weakref.WeakKeyDictionary[asyncio.Event, asyncio.AbstractEventLoop]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
 def _on_sighup() -> None:
-    for event in list(_sighup_events):
-        event.set()
+    for event, loop in list(_sighup_events.items()):
+        loop.call_soon_threadsafe(event.set)
 
 
 def local_file(path: str,
@@ -51,7 +56,7 @@ def local_file(path: str,
     event = asyncio.Event()
     event.set()  # initial read
     loop = loop or asyncio.get_event_loop()
-    _sighup_events.add(event)
+    _sighup_events[event] = loop
     try:
         loop.add_signal_handler(signal.SIGHUP, _on_sighup)
     except (NotImplementedError, RuntimeError, ValueError):
@@ -164,12 +169,15 @@ def etcd(key: str, endpoints: List[str]) -> Source:
                 state["retries"] = 0
                 return value
             # Missing key, or the watch degraded (error/timeout) and the
-            # value is unchanged: back off instead of busy-reloading the
-            # same config.
+            # value is unchanged: sleep instead of busy-reloading the same
+            # config. Only actual errors escalate the backoff — a healthy
+            # but idle key keeps the minimum sleep, so a real change is
+            # still picked up within one watch cycle.
             await asyncio.sleep(
                 backoff(MIN_BACKOFF, MAX_BACKOFF, state["retries"])
             )
-            state["retries"] += 1
+            if value is None:
+                state["retries"] += 1
 
     return source
 
