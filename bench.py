@@ -87,6 +87,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from doorman_tpu.solver.dense import DenseBatch, solve_dense
+    from doorman_tpu.solver.pallas_dense import solve_dense_pallas
 
     device = jax.devices()[0]
     if device.platform == "cpu":
@@ -94,6 +95,10 @@ def main() -> None:
         dtype = np.float64
     else:
         dtype = np.float32
+    if device.platform == "tpu":
+        solve = solve_dense_pallas  # fused VMEM kernel for the solve
+    else:
+        solve = solve_dense  # the pallas compiled path is TPU-only
 
     rng = np.random.default_rng(42)
     R, K, C = NUM_RESOURCES, BUCKET_K, CLIENTS_PER_RESOURCE
@@ -120,7 +125,7 @@ def main() -> None:
     @partial(jax.jit, donate_argnums=(0, 1))
     def tick(wants, has, idx, rows, refresh_idx):
         wants = wants.at[idx].set(rows)
-        gets = solve_dense(
+        gets = solve(
             DenseBatch(
                 wants=wants, has=has, subclients=sub_d, active=active_d,
                 capacity=cap_d, algo_kind=kind_d, learning=learning_d,

@@ -45,7 +45,10 @@ _sighup_events: "weakref.WeakKeyDictionary[asyncio.Event, asyncio.AbstractEventL
 
 def _on_sighup() -> None:
     for event, loop in list(_sighup_events.items()):
-        loop.call_soon_threadsafe(event.set)
+        try:
+            loop.call_soon_threadsafe(event.set)
+        except RuntimeError:
+            pass  # that source's loop already closed; wake the rest
 
 
 def local_file(path: str,
@@ -109,19 +112,25 @@ class _EtcdGateway:
             return None
         return base64.b64decode(kvs[0]["value"])
 
-    def wait_for_change(self, key: str, timeout: float = 60.0) -> None:
+    def wait_for_change(self, key: str, timeout: float = 60.0) -> bool:
         """Block until the key changes (or timeout); one-shot watch.
 
         /v3/watch is a never-closing newline-delimited JSON stream: the
         first frame acknowledges watch creation, each later frame carries
-        events. Read frame-by-frame and return on the first event frame;
-        on any error or timeout, degrade to polling."""
+        events. Read frame-by-frame and return on the first event frame.
+
+        Returns True when a watch was actually established (an event
+        arrived, the stream closed cleanly, or it idled past the read
+        timeout after the creation ack) — the caller keeps fast polling.
+        Returns False when every endpoint failed before establishing a
+        watch — the caller should escalate its backoff."""
         payload = {
             "create_request": {
                 "key": base64.b64encode(key.encode()).decode()
             }
         }
         for endpoint in self.endpoints:
+            established = False
             try:
                 req = urllib.request.Request(
                     endpoint + "/v3/watch",
@@ -132,18 +141,23 @@ class _EtcdGateway:
                     while True:
                         line = resp.readline()
                         if not line:
-                            return  # stream closed
+                            return True  # stream closed cleanly
                         try:
                             frame = json.loads(line.decode())
                         except ValueError:
-                            return
+                            return True
+                        established = True  # got a frame (creation ack)
                         result = frame.get("result", frame)
                         if result.get("events"):
-                            return  # the key changed
-                        # else: the creation ack; keep waiting
+                            return True  # the key changed
+                        # else: keep waiting for an event frame
             except Exception:
-                continue  # next endpoint, or fall through to polling
-        return
+                if established:
+                    # Idle timeout on a live watch: healthy, just no
+                    # change within `timeout`.
+                    return True
+                continue  # endpoint failed before the watch existed
+        return False
 
 
 def etcd(key: str, endpoints: List[str]) -> Source:
@@ -155,8 +169,9 @@ def etcd(key: str, endpoints: List[str]) -> Source:
     async def source() -> bytes:
         loop = asyncio.get_event_loop()
         while True:
+            watch_ok = True
             if state["last"] is not None:
-                await loop.run_in_executor(
+                watch_ok = await loop.run_in_executor(
                     None, gateway.wait_for_change, key
                 )
             try:
@@ -168,15 +183,15 @@ def etcd(key: str, endpoints: List[str]) -> Source:
                 state["last"] = value
                 state["retries"] = 0
                 return value
-            # Missing key, or the watch degraded (error/timeout) and the
-            # value is unchanged: sleep instead of busy-reloading the same
-            # config. Only actual errors escalate the backoff — a healthy
-            # but idle key keeps the minimum sleep, so a real change is
-            # still picked up within one watch cycle.
+            # Missing key, broken watch, or unchanged value: sleep instead
+            # of busy-reloading the same config. Only actual errors (no
+            # value, or a watch that could not be established) escalate
+            # the backoff — a healthy idle key keeps the minimum sleep, so
+            # a real change is still picked up within one watch cycle.
             await asyncio.sleep(
                 backoff(MIN_BACKOFF, MAX_BACKOFF, state["retries"])
             )
-            if value is None:
+            if value is None or not watch_ok:
                 state["retries"] += 1
 
     return source

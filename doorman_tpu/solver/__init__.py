@@ -20,3 +20,4 @@ from doorman_tpu.solver.dense import (  # noqa: F401
     solve_dense_jit,
 )
 from doorman_tpu.solver.fairshare import waterfill_levels  # noqa: F401
+from doorman_tpu.solver.pallas_dense import solve_dense_pallas  # noqa: F401

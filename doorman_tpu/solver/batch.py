@@ -88,9 +88,9 @@ class BatchSolver:
     def solve(self, snap: Snapshot) -> np.ndarray:
         """Phase 2 (device; blocking — safe to run in an executor thread,
         touches no host store state)."""
-        return np.asarray(
-            jax.block_until_ready(self._solve(snap.edges, snap.resources))
-        )
+        # device_get, not np.asarray: on tunneled platforms (axon) asarray
+        # takes a pathologically slow element-wise path.
+        return jax.device_get(self._solve(snap.edges, snap.resources))
 
     def apply(
         self,

@@ -152,18 +152,18 @@ def solve_lanes(
         fits, wants, jnp.minimum(wants, expand(level) * sub)
     )
 
+    # A where-chain rather than jnp.select: identical semantics, and it
+    # lowers on every backend pallas targets (select's argmax does not).
     kind_e = expand(algo_kind)
-    gets = jnp.select(
-        [
-            kind_e == AlgoKind.NO_ALGORITHM,
-            kind_e == AlgoKind.STATIC,
-            kind_e == AlgoKind.PROPORTIONAL_SHARE,
-            kind_e == AlgoKind.FAIR_SHARE,
-            kind_e == AlgoKind.PROPORTIONAL_TOPUP,
-        ],
-        [gets_none, gets_static, gets_prop, gets_fair, gets_topup],
-        default=zero,
-    )
+    gets = jnp.zeros_like(wants)
+    for kind_value, lane in (
+        (AlgoKind.NO_ALGORITHM, gets_none),
+        (AlgoKind.STATIC, gets_static),
+        (AlgoKind.PROPORTIONAL_SHARE, gets_prop),
+        (AlgoKind.FAIR_SHARE, gets_fair),
+        (AlgoKind.PROPORTIONAL_TOPUP, gets_topup),
+    ):
+        gets = jnp.where(kind_e == kind_value, lane, gets)
     # Learning-mode resources replay reported grants regardless of lane
     # (reference resource.go:108-111).
     gets = jnp.where(expand(learning), gets_learn, gets)

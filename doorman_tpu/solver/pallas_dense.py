@@ -1,0 +1,111 @@
+"""Pallas TPU kernel for the dense bucketed solve.
+
+Same semantics as dense.solve_dense (the shared lane math in
+doorman_tpu.solver.lanes — reference algorithm.go:44-313 and
+simulation/algo_proportional.py:31-65), but fused into one VMEM-resident
+kernel. Under plain XLA the fair-share water-fill re-reads the [R, K]
+demand tiles from HBM on every bisection iteration (~50 passes); here a
+grid step loads its row tile into VMEM once, runs every algorithm lane
+and the full bisection on-chip, and writes the grant tile back — one HBM
+read and one write per element regardless of iteration count.
+
+Layout: the [R, K] arrays tile along R (TILE_R rows per grid step, K
+lanes wide); per-resource vectors ride along as [R, 1] columns. Bool
+masks travel as compute-dtype {0,1} columns because TPU VMEM tiling is
+specified for numeric dtypes; they are re-derived with `> 0` in-kernel.
+R and K are padded to tile boundaries (padding rows solve as garbage and
+are sliced off; padded lanes are inactive by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from doorman_tpu.solver.dense import DenseBatch
+from doorman_tpu.solver.lanes import solve_lanes
+
+TILE_R = 256
+LANE = 128
+
+
+def _kernel(wants_ref, has_ref, sub_ref, active_ref, cap_ref, kind_ref,
+            learn_ref, static_ref, out_ref):
+    out_ref[:] = solve_lanes(
+        wants_ref[:],
+        has_ref[:],
+        sub_ref[:],
+        active_ref[:] > 0,
+        cap_ref[:],
+        kind_ref[:],
+        learn_ref[:] > 0,
+        static_ref[:],
+        segsum=lambda v: jnp.sum(v, axis=1, keepdims=True),
+        segmax=lambda v: jnp.max(v, axis=1, keepdims=True),
+        expand=lambda t: t,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def solve_dense_pallas(batch: DenseBatch, interpret: bool = False) -> jax.Array:
+    """Grants [R, K]; bit-compatible with dense.solve_dense.
+
+    `interpret=True` runs the kernel in the pallas interpreter — the
+    CPU-mesh test path; on TPU leave it False.
+    """
+    R, K = batch.wants.shape
+    dtype = batch.wants.dtype
+    rpad = (-R) % TILE_R
+    kpad = (-K) % LANE
+
+    def tile(x):  # [R, K] compute-dtype, padded
+        x = x.astype(dtype)
+        if rpad or kpad:
+            x = jnp.pad(x, ((0, rpad), (0, kpad)))
+        return x
+
+    def col(x, cdtype):  # [R] -> [Rpad, 1]
+        x = x.astype(cdtype)[:, None]
+        if rpad:
+            x = jnp.pad(x, ((0, rpad), (0, 0)))
+        return x
+
+    Rp, Kp = R + rpad, K + kpad
+    grid = (Rp // TILE_R,)
+    row_spec = pl.BlockSpec(
+        (TILE_R, Kp), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    col_spec = pl.BlockSpec(
+        (TILE_R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    gets = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((Rp, Kp), dtype),
+        grid=grid,
+        in_specs=[
+            row_spec,  # wants
+            row_spec,  # has
+            row_spec,  # subclients
+            row_spec,  # active mask
+            col_spec,  # capacity
+            col_spec,  # algo_kind
+            col_spec,  # learning mask
+            col_spec,  # static_capacity
+        ],
+        out_specs=row_spec,
+        interpret=interpret,
+    )(
+        tile(batch.wants),
+        tile(batch.has),
+        tile(batch.subclients),
+        tile(batch.active),
+        col(batch.capacity, dtype),
+        col(batch.algo_kind, jnp.int32),
+        col(batch.learning, dtype),
+        col(batch.static_capacity, dtype),
+    )
+    return gets[:R, :K]

@@ -1,0 +1,83 @@
+"""Parity of the pallas dense kernel against the XLA dense solve and the
+numpy oracles (interpret mode on the CPU mesh; the TPU lowering is
+exercised by bench.py's spot check on real hardware)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from doorman_tpu.algorithms import tick as oracle
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.solver.dense import DenseBatch, solve_dense
+from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+
+def random_batch(rng, R, K, C, kinds=(0, 1, 2, 3, 4), learning_p=0.1):
+    active = np.zeros((R, K), bool)
+    for r in range(R):
+        active[r, : rng.integers(1, C + 1)] = True
+    dtype = np.float32
+    return DenseBatch(
+        wants=jnp.asarray((rng.integers(0, 100, (R, K)) * active), dtype),
+        has=jnp.asarray((rng.integers(0, 50, (R, K)) * active), dtype),
+        subclients=jnp.asarray(
+            rng.integers(1, 4, (R, K)) * active, dtype
+        ),
+        active=jnp.asarray(active),
+        capacity=jnp.asarray(rng.integers(50, 10_000, R), dtype),
+        algo_kind=jnp.asarray(rng.choice(kinds, R), jnp.int32),
+        learning=jnp.asarray(rng.random(R) < learning_p),
+        static_capacity=jnp.asarray(rng.integers(1, 100, R), dtype),
+    )
+
+
+@pytest.mark.parametrize("R,K", [(7, 128), (300, 128), (40, 64)])
+def test_pallas_matches_xla_dense(R, K):
+    rng = np.random.default_rng(R * K)
+    batch = random_batch(rng, R, K, min(K, 100))
+    a = np.asarray(solve_dense(batch))
+    b = np.asarray(solve_dense_pallas(batch, interpret=True))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-4)
+
+
+def test_pallas_matches_numpy_oracles_per_kind():
+    rng = np.random.default_rng(7)
+    R, K, C = 60, 128, 100
+    batch = random_batch(rng, R, K, C, learning_p=0.0)
+    gets = np.asarray(solve_dense_pallas(batch, interpret=True))
+    active = np.asarray(batch.active)
+    wants = np.asarray(batch.wants, np.float64)
+    has = np.asarray(batch.has, np.float64)
+    sub = np.asarray(batch.subclients, np.float64)
+    for r in range(R):
+        m = active[r]
+        w, h, s = wants[r, m], has[r, m], sub[r, m]
+        c = float(batch.capacity[r])
+        k = int(batch.algo_kind[r])
+        if k == AlgoKind.NO_ALGORITHM:
+            expected = oracle.none_tick(w)
+        elif k == AlgoKind.STATIC:
+            expected = oracle.static_tick(float(batch.static_capacity[r]), w)
+        elif k == AlgoKind.PROPORTIONAL_SHARE:
+            expected = oracle.proportional_snapshot(c, w, h)
+        elif k == AlgoKind.PROPORTIONAL_TOPUP:
+            expected = oracle.proportional_topup_snapshot(c, w, h, s)
+        else:
+            expected = oracle.fair_share_waterfill(c, w, s)
+        np.testing.assert_allclose(
+            gets[r, m].astype(np.float64), expected, rtol=2e-5, atol=1e-3,
+            err_msg=f"resource {r} kind {k}",
+        )
+
+
+def test_pallas_learning_lane_and_padding():
+    rng = np.random.default_rng(3)
+    # R deliberately not a multiple of the row tile, K not of the lane
+    # width: exercises both pad-and-slice paths.
+    batch = random_batch(rng, 13, 64, 40, learning_p=1.0)
+    gets = np.asarray(solve_dense_pallas(batch, interpret=True))
+    active = np.asarray(batch.active)
+    np.testing.assert_allclose(
+        gets[active], np.asarray(batch.has)[active], rtol=1e-6
+    )
+    assert (gets[~active] == 0).all()
