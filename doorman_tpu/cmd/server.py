@@ -61,6 +61,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "batched device solve")
     p.add_argument("--tick-interval", type=float, default=1.0,
                    help="batch mode: seconds between device solves")
+    p.add_argument("--native-store", action="store_true",
+                   help="back lease stores with the C++ engine "
+                        "(doorman_tpu/native; falls back to the Python "
+                        "store if the build is unavailable)")
     p.add_argument("--minimum-refresh-interval", type=float, default=5.0,
                    help="floor for client refresh intervals")
     p.add_argument("--tls-cert", default="", help="TLS certificate file")
@@ -100,6 +104,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         mode=args.mode,
         tick_interval=args.tick_interval,
         minimum_refresh_interval=args.minimum_refresh_interval,
+        native_store=args.native_store,
     )
 
     port = await server.start(

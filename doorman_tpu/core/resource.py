@@ -47,10 +47,17 @@ class Resource:
         *,
         learning_mode_end: float = 0.0,
         clock: Callable[[], float] = time.time,
+        store_factory: Optional[Callable[[str], LeaseStore]] = None,
     ):
         self.id = resource_id
         self._clock = clock
-        self.store = LeaseStore(resource_id, clock=clock)
+        # store_factory lets the server back all resources with the native
+        # C++ engine (doorman_tpu.native); default is the Python store.
+        self.store = (
+            store_factory(resource_id)
+            if store_factory is not None
+            else LeaseStore(resource_id, clock=clock)
+        )
         self.learning_mode_end = learning_mode_end
         # Expiry of the capacity lease this (intermediate) server holds from
         # its parent; None on the root. Expired parent lease => capacity 0.

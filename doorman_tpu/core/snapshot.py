@@ -46,23 +46,43 @@ class ResourceSpec:
 @dataclass
 class Snapshot:
     """A packed tick: device-ready batches plus the index maps needed to
-    scatter results back to (resource, client) pairs."""
+    scatter results back to (resource, client) pairs.
+
+    Two flavors share this type: the Python-store pack carries explicit
+    `edge_keys`; the native-engine pack (doorman_tpu.native) instead
+    carries the raw `ridx`/`cids` handle arrays plus the engine, and
+    resolves names only when asked."""
 
     edges: EdgeBatch
     resources: ResourceBatch
-    # Parallel to the packed edge order:
+    # Parallel to the packed edge order (Python pack):
     edge_keys: List[Tuple[str, str]]  # (resource_id, client_id)
     resource_ids: List[str]
     num_edges: int
+    # Native pack only:
+    engine: object = None
+    ridx: "np.ndarray | None" = None  # [num_edges] segment per edge
+    cids: "np.ndarray | None" = None  # [num_edges] client handles
+
+    def keys(self) -> List[Tuple[str, str]]:
+        """(resource_id, client_id) per packed edge, either flavor."""
+        if self.edge_keys:
+            return self.edge_keys
+        if self.engine is None:
+            return []
+        name = self.engine.client_name
+        return [
+            (self.resource_ids[int(r)], name(int(c)))
+            for r, c in zip(self.ridx, self.cids)
+        ]
 
     def unpack(self, gets: np.ndarray) -> Dict[Tuple[str, str], float]:
         """Map a solved gets[E] array back to {(resource_id, client_id):
         grant}."""
-        out = {}
         arr = np.asarray(gets)
-        for i, key in enumerate(self.edge_keys):
-            out[key] = float(arr[i])
-        return out
+        return {
+            key: float(arr[i]) for i, key in enumerate(self.keys())
+        }
 
 
 def pack_snapshot(
@@ -86,7 +106,6 @@ def pack_snapshot(
     sub_l: List[float] = []
     rid_l: List[int] = []
 
-    resource_ids = [s.resource_id for s in specs]
     for r, spec in enumerate(specs):
         for client_id, wants, has, subclients in rows(spec.resource_id):
             edge_keys.append((spec.resource_id, client_id))
@@ -95,18 +114,50 @@ def pack_snapshot(
             has_l.append(has)
             sub_l.append(subclients)
 
-    E = _bucket(max(len(edge_keys), 1), edge_bucket_min)
+    return pack_edge_arrays(
+        specs,
+        np.asarray(rid_l, np.int32),
+        np.asarray(wants_l, dtype),
+        np.asarray(has_l, dtype),
+        np.asarray(sub_l, dtype),
+        dtype=dtype,
+        edge_bucket_min=edge_bucket_min,
+        resource_bucket_min=resource_bucket_min,
+        to_device=to_device,
+        edge_keys=edge_keys,
+    )
+
+
+def pack_edge_arrays(
+    specs: Sequence[ResourceSpec],
+    rid: np.ndarray,
+    wants: np.ndarray,
+    has: np.ndarray,
+    sub: np.ndarray,
+    *,
+    dtype=np.float64,
+    edge_bucket_min: int = 64,
+    resource_bucket_min: int = 16,
+    to_device: Callable[[np.ndarray], object] | None = None,
+    edge_keys: List[Tuple[str, str]] | None = None,
+    engine: object = None,
+    cids: np.ndarray | None = None,
+) -> Snapshot:
+    """Pad already-flat edge arrays into a Snapshot. The list-based
+    `pack_snapshot` and the native engine's bulk pack both land here."""
+    n = len(rid)
+    E = _bucket(max(n, 1), edge_bucket_min)
     R = _bucket(max(len(specs), 1), resource_bucket_min)
 
-    def fpad(xs: List[float], fill=0.0) -> np.ndarray:
-        arr = np.full(E, fill, dtype=dtype)
-        arr[: len(xs)] = xs
+    def fpad(xs: np.ndarray) -> np.ndarray:
+        arr = np.zeros(E, dtype=dtype)
+        arr[:n] = xs
         return arr
 
-    rid = np.full(E, R - 1, dtype=np.int32)
-    rid[: len(rid_l)] = rid_l
+    rid_pad = np.full(E, R - 1, dtype=np.int32)
+    rid_pad[:n] = rid
     active = np.zeros(E, dtype=bool)
-    active[: len(edge_keys)] = True
+    active[:n] = True
 
     cap = np.zeros(R, dtype=dtype)
     kind = np.zeros(R, dtype=np.int32)
@@ -120,10 +171,10 @@ def pack_snapshot(
 
     dev = to_device if to_device is not None else (lambda a: a)
     edges = EdgeBatch(
-        resource=dev(rid),
-        wants=dev(fpad(wants_l)),
-        has=dev(fpad(has_l)),
-        subclients=dev(fpad(sub_l)),
+        resource=dev(rid_pad),
+        wants=dev(fpad(wants)),
+        has=dev(fpad(has)),
+        subclients=dev(fpad(sub)),
         active=dev(active),
     )
     resources = ResourceBatch(
@@ -135,7 +186,10 @@ def pack_snapshot(
     return Snapshot(
         edges=edges,
         resources=resources,
-        edge_keys=edge_keys,
-        resource_ids=resource_ids,
-        num_edges=len(edge_keys),
+        edge_keys=edge_keys or [],
+        resource_ids=[s.resource_id for s in specs],
+        num_edges=n,
+        engine=engine,
+        ridx=rid if engine is not None else None,
+        cids=cids,
     )

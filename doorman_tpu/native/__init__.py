@@ -1,0 +1,352 @@
+"""Native (C++) host-runtime components, bound over ctypes.
+
+The TPU owns the allocation solve; the runtime around it — lease
+bookkeeping on every request, the snapshot pack on every tick — is the
+host-side hot path. `store.cc` implements that path as a single Engine
+holding all of a server's resources; this module builds it on demand
+(g++ is in the image; there is no pip/pybind11) and wraps it in
+`NativeLeaseStore`, a drop-in for the Python `LeaseStore`.
+
+Everything degrades gracefully: if the toolchain or the build is
+unavailable, `native_available()` is False and callers stay on the
+Python store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from doorman_tpu.core.lease import Lease, ZERO_LEASE
+from doorman_tpu.core.store import ClientLeaseStatus, ResourceLeaseStatus
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parent / "store.cc"
+_LIB = Path(__file__).resolve().parent / "_store.so"
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+_load_failed = False
+
+_F64P = ctypes.POINTER(ctypes.c_double)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def _build() -> None:
+    # Build into a temp file then rename: atomic under concurrent pytest
+    # workers.
+    fd, tmp = tempfile.mkstemp(
+        suffix=".so", dir=str(_LIB.parent), prefix="_store_build_"
+    )
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             str(_SRC), "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.dm_engine_new.restype = ctypes.c_void_p
+    lib.dm_engine_free.argtypes = [ctypes.c_void_p]
+    lib.dm_resource.restype = ctypes.c_int32
+    lib.dm_resource.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dm_client.restype = ctypes.c_int64
+    lib.dm_client.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dm_assign.restype = ctypes.c_int32
+    lib.dm_assign.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+    ]
+    lib.dm_release.restype = ctypes.c_int32
+    lib.dm_release.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.c_int64]
+    lib.dm_clean.restype = ctypes.c_int64
+    lib.dm_clean.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                             ctypes.c_double]
+    lib.dm_sums.argtypes = [ctypes.c_void_p, ctypes.c_int32, _F64P]
+    lib.dm_get.restype = ctypes.c_int32
+    lib.dm_get.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+                           _F64P]
+    lib.dm_dump.restype = ctypes.c_int64
+    lib.dm_dump.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, _I64P, _F64P, _F64P, _F64P, _F64P,
+        _I32P, ctypes.c_int64,
+    ]
+    lib.dm_total_leases.restype = ctypes.c_int64
+    lib.dm_total_leases.argtypes = [ctypes.c_void_p]
+    lib.dm_pack.restype = ctypes.c_int64
+    lib.dm_pack.argtypes = [
+        ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P, _F64P,
+        _F64P, ctypes.c_int64,
+    ]
+    lib.dm_apply.restype = ctypes.c_int64
+    lib.dm_apply.argtypes = [
+        ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P,
+        ctypes.c_int64, _F64P, _F64P, ctypes.POINTER(ctypes.c_uint8),
+    ]
+
+
+def _load() -> "ctypes.CDLL | None":
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if (not _LIB.exists()
+                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                _build()
+            try:
+                lib = ctypes.CDLL(str(_LIB))
+            except OSError:
+                # A stale or foreign-platform .so; rebuild once and retry.
+                _build()
+                lib = ctypes.CDLL(str(_LIB))
+            _declare(lib)
+            _lib = lib
+        except Exception:
+            log.exception("native store unavailable; using Python store")
+            _load_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class StoreEngine:
+    """One engine per server: every resource's leases in native memory.
+
+    `store(resource_id)` hands out `NativeLeaseStore` views; `pack` dumps
+    the whole engine as resource-major edge arrays for the batch solver.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native store engine unavailable (g++ build failed?); "
+                "check native_available() before constructing"
+            )
+        self._lib = lib
+        self._ptr = ctypes.c_void_p(lib.dm_engine_new())
+        self._clock = clock
+        self._client_names: List[str] = []
+        self._client_handles: dict[str, int] = {}
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr and getattr(self, "_lib", None) is not None:
+            self._lib.dm_engine_free(ptr)
+
+    def client_handle(self, client_id: str) -> int:
+        h = self._client_handles.get(client_id)
+        if h is None:
+            h = self._lib.dm_client(self._ptr, client_id.encode())
+            self._client_handles[client_id] = h
+            assert h == len(self._client_names)
+            self._client_names.append(client_id)
+        return h
+
+    def client_name(self, handle: int) -> str:
+        return self._client_names[handle]
+
+    def store(self, resource_id: str) -> "NativeLeaseStore":
+        rid = self._lib.dm_resource(self._ptr, resource_id.encode())
+        return NativeLeaseStore(self, resource_id, rid)
+
+    @property
+    def total_leases(self) -> int:
+        return self._lib.dm_total_leases(self._ptr)
+
+    def pack(
+        self, order: List["NativeLeaseStore"]
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Resource-major edge dump following `order`: returns
+        (ridx, cid, wants, has, subclients) with ridx the position of the
+        edge's resource in `order` — the solver's segment id."""
+        cap = self._lib.dm_total_leases(self._ptr)
+        ridx = np.empty(cap, np.int32)
+        cid = np.empty(cap, np.int64)
+        wants = np.empty(cap, np.float64)
+        has = np.empty(cap, np.float64)
+        sub = np.empty(cap, np.float64)
+        handles = np.asarray([s._rid for s in order], np.int32)
+        n = self._lib.dm_pack(
+            self._ptr,
+            handles.ctypes.data_as(_I32P), len(order),
+            ridx.ctypes.data_as(_I32P), cid.ctypes.data_as(_I64P),
+            wants.ctypes.data_as(_F64P), has.ctypes.data_as(_F64P),
+            sub.ctypes.data_as(_F64P), cap,
+        )
+        return ridx[:n], cid[:n], wants[:n], has[:n], sub[:n]
+
+    def apply(
+        self,
+        order_rids: np.ndarray,  # [n_seg] engine rids; -1 skips a segment
+        ridx: np.ndarray,  # [E] segment per edge
+        cid: np.ndarray,  # [E]
+        gets: np.ndarray,  # [E]
+        expiry: np.ndarray,  # [n_seg] absolute expiry stamps
+        refresh: np.ndarray,  # [n_seg]
+    ) -> np.ndarray:
+        """Bulk grant write-back; returns a bool mask of edges applied
+        (False: client released or resource gone mid-solve)."""
+        order_rids = np.ascontiguousarray(order_rids, np.int32)
+        ridx = np.ascontiguousarray(ridx, np.int32)
+        cid = np.ascontiguousarray(cid, np.int64)
+        gets = np.ascontiguousarray(gets, np.float64)
+        expiry = np.ascontiguousarray(expiry, np.float64)
+        refresh = np.ascontiguousarray(refresh, np.float64)
+        applied = np.zeros(len(ridx), np.uint8)
+        self._lib.dm_apply(
+            self._ptr,
+            order_rids.ctypes.data_as(_I32P), len(order_rids),
+            ridx.ctypes.data_as(_I32P), cid.ctypes.data_as(_I64P),
+            gets.ctypes.data_as(_F64P), len(ridx),
+            expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
+            applied.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return applied.astype(bool)
+
+
+class NativeLeaseStore:
+    """Drop-in for core.store.LeaseStore, backed by a StoreEngine.
+
+    Same interface and semantics (cites store.py; ultimately reference
+    store.go:68-213); construct via StoreEngine.store().
+    """
+
+    def __init__(self, engine: StoreEngine, resource_id: str, rid: int):
+        self.id = resource_id
+        self._engine = engine
+        self._lib = engine._lib
+        self._ptr = engine._ptr
+        self._rid = rid
+        self._clock = engine._clock
+        self._out = np.empty(5, np.float64)  # dm_get scratch
+
+    def _sums(self) -> np.ndarray:
+        out = np.empty(4, np.float64)
+        self._lib.dm_sums(self._ptr, self._rid, out.ctypes.data_as(_F64P))
+        return out
+
+    def __len__(self) -> int:
+        return int(self._sums()[3])
+
+    @property
+    def count(self) -> int:
+        return int(self._sums()[2])
+
+    @property
+    def sum_has(self) -> float:
+        return float(self._sums()[0])
+
+    @property
+    def sum_wants(self) -> float:
+        return float(self._sums()[1])
+
+    def get(self, client: str) -> Lease:
+        ok = self._lib.dm_get(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            self._out.ctypes.data_as(_F64P),
+        )
+        if not ok:
+            return ZERO_LEASE
+        e, r, h, w, s = self._out
+        return Lease(expiry=e, refresh_interval=r, has=h, wants=w,
+                     subclients=int(s))
+
+    def has_client(self, client: str) -> bool:
+        return bool(self._lib.dm_get(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            self._out.ctypes.data_as(_F64P),
+        ))
+
+    def subclients(self, client: str) -> int:
+        return self.get(client).subclients
+
+    def assign(
+        self,
+        client: str,
+        lease_length: float,
+        refresh_interval: float,
+        has: float,
+        wants: float,
+        subclients: int,
+    ) -> Lease:
+        expiry = self._clock() + lease_length
+        self._lib.dm_assign(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            expiry, refresh_interval, has, wants, subclients,
+        )
+        return Lease(expiry=expiry, refresh_interval=refresh_interval,
+                     has=has, wants=wants, subclients=subclients)
+
+    def release(self, client: str) -> None:
+        self._lib.dm_release(
+            self._ptr, self._rid, self._engine.client_handle(client)
+        )
+
+    def clean(self) -> int:
+        return self._lib.dm_clean(self._ptr, self._rid, self._clock())
+
+    def _dump(self):
+        n = len(self)
+        cids = np.empty(n, np.int64)
+        expiry = np.empty(n, np.float64)
+        refresh = np.empty(n, np.float64)
+        has = np.empty(n, np.float64)
+        wants = np.empty(n, np.float64)
+        sub = np.empty(n, np.int32)
+        n = self._lib.dm_dump(
+            self._ptr, self._rid, cids.ctypes.data_as(_I64P),
+            expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
+            has.ctypes.data_as(_F64P), wants.ctypes.data_as(_F64P),
+            sub.ctypes.data_as(_I32P), n,
+        )
+        return cids[:n], expiry[:n], refresh[:n], has[:n], wants[:n], sub[:n]
+
+    def items(self) -> Iterator[Tuple[str, Lease]]:
+        cids, expiry, refresh, has, wants, sub = self._dump()
+        name = self._engine.client_name
+        for i in range(len(cids)):
+            yield name(int(cids[i])), Lease(
+                expiry=float(expiry[i]),
+                refresh_interval=float(refresh[i]),
+                has=float(has[i]),
+                wants=float(wants[i]),
+                subclients=int(sub[i]),
+            )
+
+    def map(self, fn: Callable[[str, Lease], None]) -> None:
+        for client, lease in self.items():
+            fn(client, lease)
+
+    def lease_status(self) -> ResourceLeaseStatus:
+        sums = self._sums()
+        return ResourceLeaseStatus(
+            id=self.id,
+            sum_has=float(sums[0]),
+            sum_wants=float(sums[1]),
+            leases=[
+                ClientLeaseStatus(client_id=c, lease=l)
+                for c, l in self.items()
+            ],
+        )

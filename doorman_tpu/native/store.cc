@@ -1,0 +1,253 @@
+// Native lease-store engine: the host-runtime hot path in C++.
+//
+// Capability parity with the Python LeaseStore
+// (doorman_tpu/core/store.py, itself mirroring reference
+// /root/reference/go/server/doorman/store.go:68-213): per-resource
+// client -> lease maps with O(1) running sum_has / sum_wants / subclient
+// aggregates, expiry sweep, and a bulk resource-major edge dump feeding
+// the batch solver's snapshot packer without per-lease Python overhead.
+//
+// One Engine holds every resource of a server, so a tick's snapshot is a
+// single dm_pack call. String ids are interned once at the boundary
+// (dm_resource / dm_client); all per-request operations afterwards are
+// integer-keyed. The clock is injected from the caller (absolute expiry
+// stamps, `now` for sweeps) so simulated time works identically to the
+// Python store.
+//
+// Iteration/packing order is deterministic: insertion order, perturbed
+// only by swap-remove on release/expiry — the same guarantee the Python
+// store documents for reproducible packing.
+
+#include <algorithm>
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Lease {
+  double expiry;
+  double refresh_interval;
+  double has;
+  double wants;
+  int32_t subclients;
+};
+
+struct ResourceStore {
+  std::unordered_map<int64_t, size_t> index;  // client handle -> slot
+  std::vector<int64_t> clients;               // slot -> client handle
+  std::vector<Lease> leases;                  // slot -> lease
+  double sum_has = 0.0;
+  double sum_wants = 0.0;
+  int64_t count = 0;  // total subclients
+
+  void remove_slot(size_t slot) {
+    const Lease &l = leases[slot];
+    sum_has -= l.has;
+    sum_wants -= l.wants;
+    count -= l.subclients;
+    index.erase(clients[slot]);
+    const size_t last = clients.size() - 1;
+    if (slot != last) {
+      clients[slot] = clients[last];
+      leases[slot] = leases[last];
+      index[clients[slot]] = slot;
+    }
+    clients.pop_back();
+    leases.pop_back();
+  }
+};
+
+struct Engine {
+  std::vector<ResourceStore> resources;
+  std::unordered_map<std::string, int32_t> resource_ids;
+  std::unordered_map<std::string, int64_t> client_ids;
+  int64_t next_client = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+Engine *dm_engine_new() { return new Engine(); }
+
+void dm_engine_free(Engine *e) { delete e; }
+
+// Get-or-create the resource store for `id`; returns its handle.
+int32_t dm_resource(Engine *e, const char *id) {
+  auto it = e->resource_ids.find(id);
+  if (it != e->resource_ids.end()) return it->second;
+  const int32_t rid = static_cast<int32_t>(e->resources.size());
+  e->resource_ids.emplace(id, rid);
+  e->resources.emplace_back();
+  return rid;
+}
+
+// Intern a client id; returns its handle (stable for the engine's life).
+int64_t dm_client(Engine *e, const char *id) {
+  auto it = e->client_ids.find(id);
+  if (it != e->client_ids.end()) return it->second;
+  const int64_t cid = e->next_client++;
+  e->client_ids.emplace(id, cid);
+  return cid;
+}
+
+// Upsert a lease; running sums update by delta. Returns 1 if the client
+// already held a lease, 0 if this is a new entry.
+int32_t dm_assign(Engine *e, int32_t rid, int64_t cid, double expiry,
+                  double refresh_interval, double has, double wants,
+                  int32_t subclients) {
+  ResourceStore &r = e->resources[rid];
+  const Lease fresh{expiry, refresh_interval, has, wants, subclients};
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) {
+    r.index.emplace(cid, r.clients.size());
+    r.clients.push_back(cid);
+    r.leases.push_back(fresh);
+    r.sum_has += has;
+    r.sum_wants += wants;
+    r.count += subclients;
+    return 0;
+  }
+  Lease &l = r.leases[it->second];
+  r.sum_has += has - l.has;
+  r.sum_wants += wants - l.wants;
+  r.count += subclients - l.subclients;
+  l = fresh;
+  return 1;
+}
+
+// Returns 1 if the client held a lease (now removed), else 0.
+int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
+  ResourceStore &r = e->resources[rid];
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) return 0;
+  r.remove_slot(it->second);
+  return 1;
+}
+
+// Sweep leases with expiry < now (strict: `now > expiry` like the Python
+// store); returns how many were removed.
+int64_t dm_clean(Engine *e, int32_t rid, double now) {
+  ResourceStore &r = e->resources[rid];
+  int64_t removed = 0;
+  for (size_t slot = 0; slot < r.leases.size();) {
+    if (now > r.leases[slot].expiry) {
+      r.remove_slot(slot);  // swap-remove: re-check the same slot
+      ++removed;
+    } else {
+      ++slot;
+    }
+  }
+  return removed;
+}
+
+// out[0]=sum_has out[1]=sum_wants out[2]=subclient count out[3]=#leases
+void dm_sums(Engine *e, int32_t rid, double *out) {
+  const ResourceStore &r = e->resources[rid];
+  out[0] = r.sum_has;
+  out[1] = r.sum_wants;
+  out[2] = static_cast<double>(r.count);
+  out[3] = static_cast<double>(r.leases.size());
+}
+
+// Fetch one lease: out = {expiry, refresh_interval, has, wants,
+// subclients}. Returns 1 if present, else 0 (out untouched).
+int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
+  const ResourceStore &r = e->resources[rid];
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) return 0;
+  const Lease &l = r.leases[it->second];
+  out[0] = l.expiry;
+  out[1] = l.refresh_interval;
+  out[2] = l.has;
+  out[3] = l.wants;
+  out[4] = l.subclients;
+  return 1;
+}
+
+// Dump one resource's leases (store order). Arrays must hold
+// dm_sums(...)[3] entries; returns the number written.
+int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
+                double *refresh, double *has, double *wants,
+                int32_t *subclients, int64_t cap) {
+  const ResourceStore &r = e->resources[rid];
+  const int64_t n =
+      std::min<int64_t>(cap, static_cast<int64_t>(r.leases.size()));
+  for (int64_t i = 0; i < n; ++i) {
+    const Lease &l = r.leases[i];
+    cids[i] = r.clients[i];
+    expiry[i] = l.expiry;
+    refresh[i] = l.refresh_interval;
+    has[i] = l.has;
+    wants[i] = l.wants;
+    subclients[i] = l.subclients;
+  }
+  return n;
+}
+
+int64_t dm_total_leases(Engine *e) {
+  int64_t total = 0;
+  for (const ResourceStore &r : e->resources)
+    total += static_cast<int64_t>(r.leases.size());
+  return total;
+}
+
+// Bulk snapshot pack: edges laid out resource-major following `order`
+// (engine resource handles, e.g. the batch solver's spec order).
+// ridx_out[i] is the POSITION in `order` (the solver's segment id), not
+// the engine handle. Returns edges written (<= cap).
+int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
+                int32_t *ridx_out, int64_t *cid_out, double *wants_out,
+                double *has_out, double *sub_out, int64_t cap) {
+  int64_t w = 0;
+  for (int32_t i = 0; i < n_order; ++i) {
+    const ResourceStore &r = e->resources[order[i]];
+    const size_t n = r.leases.size();
+    for (size_t j = 0; j < n; ++j) {
+      if (w >= cap) return w;
+      const Lease &l = r.leases[j];
+      ridx_out[w] = i;
+      cid_out[w] = r.clients[j];
+      wants_out[w] = l.wants;
+      has_out[w] = l.has;
+      sub_out[w] = l.subclients;
+      ++w;
+    }
+  }
+  return w;
+}
+
+// Bulk grant write-back after a solve: for each edge, if the client
+// still holds a lease, set has=gets and stamp the segment's fresh
+// expiry/refresh; wants/subclients keep their CURRENT store values so
+// demand that changed while the solve was in flight is preserved (same
+// semantics as BatchSolver.apply). order[seg] < 0 skips that segment
+// (its resource vanished mid-solve). applied_out[i] is 1 where the edge
+// was written. Returns the number applied.
+int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
+                 const int32_t *ridx, const int64_t *cid,
+                 const double *gets, int64_t n_edges,
+                 const double *expiry, const double *refresh,
+                 uint8_t *applied_out) {
+  int64_t applied = 0;
+  for (int64_t i = 0; i < n_edges; ++i) {
+    applied_out[i] = 0;
+    const int32_t seg = ridx[i];
+    if (seg < 0 || seg >= n_order || order[seg] < 0) continue;
+    ResourceStore &r = e->resources[order[seg]];
+    auto it = r.index.find(cid[i]);
+    if (it == r.index.end()) continue;  // released mid-solve
+    Lease &l = r.leases[it->second];
+    r.sum_has += gets[i] - l.has;
+    l.has = gets[i];
+    l.expiry = expiry[seg];
+    l.refresh_interval = refresh[seg];
+    applied_out[i] = 1;
+    ++applied;
+  }
+  return applied;
+}
+
+}  // extern "C"

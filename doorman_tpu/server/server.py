@@ -73,6 +73,7 @@ class CapacityServer(CapacityServicer):
         tick_interval: float = 1.0,
         minimum_refresh_interval: float = 5.0,
         clock: Callable[[], float] = time.time,
+        native_store: bool = False,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -82,6 +83,22 @@ class CapacityServer(CapacityServicer):
         self.tick_interval = tick_interval
         self.minimum_refresh_interval = minimum_refresh_interval
         self._clock = clock
+
+        # All resources share one native engine when requested (falls back
+        # to the Python store if the C++ build is unavailable).
+        self._native_store = False
+        self._store_factory = None
+        if native_store:
+            from doorman_tpu import native
+
+            if native.native_available():
+                self._native_store = True
+                self._reset_store_engine()
+            else:
+                log.warning(
+                    "%s: native store requested but unavailable; "
+                    "using the Python store", server_id,
+                )
 
         self.resources: Dict[str, Resource] = {}
         self.is_master = False
@@ -192,6 +209,15 @@ class CapacityServer(CapacityServicer):
                 expiry_times.get(resource_id),
             )
 
+    def _reset_store_engine(self) -> None:
+        """A fresh native engine: dropping the resources map must also drop
+        the engine-held leases (the engine is get-or-create by id)."""
+        if self._native_store:
+            from doorman_tpu import native
+
+            engine = native.StoreEngine(clock=self._clock)
+            self._store_factory = engine.store
+
     async def _on_is_master(self, is_master: bool) -> None:
         """Mastership changes wipe all lease state; a fresh master starts in
         learning mode (server.go:438-455)."""
@@ -199,11 +225,11 @@ class CapacityServer(CapacityServicer):
         if is_master:
             log.info("%s: this server is now the master", self.id)
             self.became_master_at = self._clock()
-            self.resources = {}
         else:
             log.warning("%s: this server lost mastership", self.id)
             self.became_master_at = 0.0
-            self.resources = {}
+        self.resources = {}
+        self._reset_store_engine()
 
     async def _on_current_master(self, master: str) -> None:
         if master != self.current_master:
@@ -236,6 +262,7 @@ class CapacityServer(CapacityServicer):
             template,
             learning_mode_end=self.learning_mode_end(duration),
             clock=self._clock,
+            store_factory=self._store_factory,
         )
         self.resources[resource_id] = res
         return res
@@ -270,7 +297,7 @@ class CapacityServer(CapacityServicer):
         snap = solver.prepare(resources)
         loop = asyncio.get_running_loop()
         gets = await loop.run_in_executor(None, solver.solve, snap)
-        solver.apply(resources, snap, gets)
+        solver.apply(resources, snap, gets, return_grants=False)
 
     async def _tick_loop(self) -> None:
         while True:

@@ -20,8 +20,30 @@ import jax
 import numpy as np
 
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
-from doorman_tpu.core.snapshot import ResourceSpec, Snapshot, pack_snapshot
+from doorman_tpu.core.snapshot import (
+    ResourceSpec,
+    Snapshot,
+    pack_edge_arrays,
+    pack_snapshot,
+)
 from doorman_tpu.solver.kernels import solve_tick_jit
+
+
+def _shared_native_engine(stores) -> "object | None":
+    """The one StoreEngine behind every store, or None if the stores are
+    not all native views on a single engine."""
+    try:
+        from doorman_tpu.native import NativeLeaseStore
+    except Exception:  # pragma: no cover - native module always importable
+        return None
+    engines = set()
+    for store in stores:
+        if not isinstance(store, NativeLeaseStore):
+            return None
+        engines.add(id(store._engine))
+    if len(engines) != 1:
+        return None
+    return stores[0]._engine
 
 
 class BatchSolver:
@@ -65,6 +87,24 @@ class BatchSolver:
             for r in res_list
         ]
 
+        # Native fast path: one C call dumps every lease of every resource
+        # as flat edge arrays — no per-lease Python objects.
+        stores = [r.store for r in res_list]
+        engine = _shared_native_engine(stores) if stores else None
+        if engine is not None:
+            ridx, cid, wants, has, sub = engine.pack(stores)
+            return pack_edge_arrays(
+                specs,
+                ridx,
+                wants.astype(self._dtype, copy=False),
+                has.astype(self._dtype, copy=False),
+                sub.astype(self._dtype, copy=False),
+                dtype=self._dtype,
+                to_device=self._to_device,
+                engine=engine,
+                cids=cid,
+            )
+
         def rows(resource_id: str):
             store = by_id[resource_id].store
             return [
@@ -97,32 +137,86 @@ class BatchSolver:
         resources: Iterable[Resource],
         snap: Snapshot,
         gets: np.ndarray,
+        *,
+        return_grants: bool = True,
     ) -> Dict[str, Dict[str, float]]:
         """Phase 3 (host, store-owning thread): write grants back with
         fresh lease expiries. Demand that changed while the solve was in
         flight is preserved (wants/subclients are re-read from the store),
-        and clients released mid-solve stay released."""
+        and clients released mid-solve stay released.
+
+        `return_grants=False` skips materializing the per-client grant
+        map — the tick loop only needs the store side effects, and at
+        100k+ leases the map rebuild is per-edge Python work."""
         by_id = {r.id: r for r in resources}
-        out: Dict[str, Dict[str, float]] = {}
-        for (resource_id, client_id), grant in snap.unpack(
-            gets[: snap.num_edges]
-        ).items():
-            res = by_id.get(resource_id)
-            if res is None or not res.store.has_client(client_id):
-                continue
-            algo = res.template.algorithm
-            old = res.store.get(client_id)
-            res.store.assign(
-                client_id,
-                float(algo.lease_length),
-                float(algo.refresh_interval),
-                grant,
-                old.wants,
-                old.subclients,
+        if snap.engine is not None:
+            out = self._apply_native(
+                by_id, snap, gets, return_grants=return_grants
             )
-            out.setdefault(resource_id, {})[client_id] = grant
+        else:
+            out = {}
+            for (resource_id, client_id), grant in snap.unpack(
+                gets[: snap.num_edges]
+            ).items():
+                res = by_id.get(resource_id)
+                if res is None or not res.store.has_client(client_id):
+                    continue
+                algo = res.template.algorithm
+                old = res.store.get(client_id)
+                res.store.assign(
+                    client_id,
+                    float(algo.lease_length),
+                    float(algo.refresh_interval),
+                    grant,
+                    old.wants,
+                    old.subclients,
+                )
+                if return_grants:
+                    out.setdefault(resource_id, {})[client_id] = grant
         self.ticks += 1
         self.last_tick_seconds = self._clock() - self._tick_start
+        return out
+
+    def _apply_native(
+        self,
+        by_id: Dict[str, Resource],
+        snap: Snapshot,
+        gets: np.ndarray,
+        *,
+        return_grants: bool = True,
+    ) -> Dict[str, Dict[str, float]]:
+        """One C call writes every grant back into the engine (same
+        skip/preserve semantics as the Python loop); the returned grant
+        map is rebuilt from the applied mask."""
+        engine = snap.engine
+        now = self._clock()
+        n_seg = len(snap.resource_ids)
+        order = np.full(n_seg, -1, np.int32)
+        expiry = np.zeros(n_seg, np.float64)
+        refresh = np.zeros(n_seg, np.float64)
+        for i, resource_id in enumerate(snap.resource_ids):
+            res = by_id.get(resource_id)
+            if res is None:
+                continue  # resource vanished mid-solve: skip its edges
+            if getattr(res.store, "_engine", None) is not engine:
+                continue  # store replaced mid-solve (mastership reset)
+            algo = res.template.algorithm
+            order[i] = res.store._rid
+            expiry[i] = now + float(algo.lease_length)
+            refresh[i] = float(algo.refresh_interval)
+        flat = np.asarray(gets[: snap.num_edges], np.float64)
+        applied = engine.apply(
+            order, snap.ridx, snap.cids, flat, expiry, refresh
+        )
+        out: Dict[str, Dict[str, float]] = {}
+        if not return_grants:
+            return out
+        name = engine.client_name
+        for i in np.nonzero(applied)[0]:
+            resource_id = snap.resource_ids[int(snap.ridx[i])]
+            out.setdefault(resource_id, {})[name(int(snap.cids[i]))] = float(
+                flat[i]
+            )
         return out
 
     def tick(self, resources: Iterable[Resource]) -> Dict[str, Dict[str, float]]:

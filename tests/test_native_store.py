@@ -1,0 +1,257 @@
+"""The C++ store engine vs the Python LeaseStore: same interface, same
+numbers, on identical operation sequences (differential testing); plus the
+bulk pack path and the server wired with --native-store."""
+
+import numpy as np
+import pytest
+
+from doorman_tpu import native
+from doorman_tpu.core.store import LeaseStore
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native store build unavailable"
+)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def pair():
+    clock = FakeClock()
+    engine = native.StoreEngine(clock=clock)
+    return LeaseStore("res", clock=clock), engine.store("res"), clock
+
+
+def test_assign_release_sums_parity(pair):
+    py, cc, clock = pair
+    rng = np.random.default_rng(0)
+    clients = [f"client-{i}" for i in range(40)]
+    for step in range(500):
+        c = clients[rng.integers(len(clients))]
+        op = rng.random()
+        if op < 0.6:
+            wants = float(rng.integers(0, 100))
+            has = float(rng.integers(0, 50))
+            sub = int(rng.integers(1, 4))
+            a = py.assign(c, 60.0, 5.0, has, wants, sub)
+            b = cc.assign(c, 60.0, 5.0, has, wants, sub)
+            assert a == b
+        elif op < 0.8:
+            py.release(c)
+            cc.release(c)
+        else:
+            clock.t += float(rng.integers(0, 30))
+            assert py.clean() == cc.clean()
+        assert len(py) == len(cc)
+        assert py.count == cc.count
+        assert py.sum_has == pytest.approx(cc.sum_has)
+        assert py.sum_wants == pytest.approx(cc.sum_wants)
+        assert py.get(c) == cc.get(c)
+        assert py.has_client(c) == cc.has_client(c)
+
+
+def test_items_and_status_content_parity(pair):
+    py, cc, clock = pair
+    for i in range(10):
+        py.assign(f"c{i}", 60.0, 5.0, float(i), float(2 * i), 1)
+        cc.assign(f"c{i}", 60.0, 5.0, float(i), float(2 * i), 1)
+    # Same content; order may differ after swap-removes, so compare as
+    # dicts (both sides are deterministic, which test_pack_* checks).
+    assert dict(py.items()) == dict(cc.items())
+    a, b = py.lease_status(), cc.lease_status()
+    assert (a.id, a.sum_has, a.sum_wants) == (b.id, b.sum_has, b.sum_wants)
+    assert {s.client_id: s.lease for s in a.leases} == {
+        s.client_id: s.lease for s in b.leases
+    }
+
+
+def test_subclients_and_zero_lease(pair):
+    py, cc, _ = pair
+    assert cc.get("ghost").is_zero
+    assert cc.subclients("ghost") == 0
+    cc.assign("c", 60.0, 5.0, 1.0, 2.0, 3)
+    assert cc.subclients("c") == 3
+
+
+def test_engine_pack_resource_major():
+    clock = FakeClock()
+    engine = native.StoreEngine(clock=clock)
+    stores = [engine.store(f"res{i}") for i in range(3)]
+    expect = []
+    for r, s in enumerate(stores):
+        for j in range(r + 1):  # 1, 2, 3 leases
+            s.assign(f"c{r}-{j}", 60.0, 5.0, float(j), float(10 * r + j),
+                     1 + j)
+            expect.append((r, f"c{r}-{j}", float(10 * r + j), float(j),
+                           float(1 + j)))
+    assert engine.total_leases == 6
+    ridx, cid, wants, has, sub = engine.pack(stores)
+    got = [
+        (int(ridx[i]), engine.client_name(int(cid[i])), wants[i], has[i],
+         sub[i])
+        for i in range(len(ridx))
+    ]
+    assert got == expect
+    # Pack order follows the caller's order argument, not creation order:
+    # reversed, res2's three leases come first as segment 0.
+    ridx2, cid2, *_ = engine.pack(stores[::-1])
+    assert [int(r) for r in ridx2] == [0, 0, 0, 1, 1, 2]
+    assert engine.client_name(int(cid2[0])) == "c2-0"
+
+
+def test_pack_after_release_swaps_deterministically():
+    clock = FakeClock()
+    engine = native.StoreEngine(clock=clock)
+    s = engine.store("res")
+    for i in range(4):
+        s.assign(f"c{i}", 60.0, 5.0, 0.0, float(i), 1)
+    s.release("c0")  # swap-remove: c3 moves into slot 0
+    names = [c for c, _ in s.items()]
+    assert names == ["c3", "c1", "c2"]
+
+
+def test_clean_exact_boundary(pair):
+    py, cc, clock = pair
+    py.assign("c", 10.0, 5.0, 1.0, 1.0, 1)  # expiry 110
+    cc.assign("c", 10.0, 5.0, 1.0, 1.0, 1)
+    clock.t = 110.0  # now == expiry: NOT expired (strict >)
+    assert py.clean() == cc.clean() == 0
+    clock.t = 110.0001
+    assert py.clean() == cc.clean() == 1
+
+
+def _make_resources(store_factory, clock, n_resources=6, n_clients=15):
+    from doorman_tpu.core.resource import Resource
+    from doorman_tpu.proto import doorman_pb2 as pb
+
+    rng = np.random.default_rng(11)
+    kinds = [
+        pb.Algorithm.PROPORTIONAL_SHARE,
+        pb.Algorithm.FAIR_SHARE,
+        pb.Algorithm.STATIC,
+        pb.Algorithm.NO_ALGORITHM,
+    ]
+    resources = []
+    for r in range(n_resources):
+        t = pb.ResourceTemplate()
+        t.identifier_glob = f"res{r}"
+        t.capacity = float(rng.integers(50, 500))
+        t.algorithm.kind = kinds[r % len(kinds)]
+        t.algorithm.lease_length = 60
+        t.algorithm.refresh_interval = 5
+        res = Resource(
+            f"res{r}", t, clock=clock, store_factory=store_factory
+        )
+        for c in range(int(rng.integers(1, n_clients))):
+            res.store.assign(
+                f"client-{c}", 60.0, 5.0,
+                float(rng.integers(0, 50)), float(rng.integers(0, 100)), 1,
+            )
+        resources.append(res)
+    return resources
+
+
+def test_batch_tick_native_matches_python():
+    """A full BatchSolver tick over native stores produces exactly the
+    grants and store state of the Python-store tick (the native pack and
+    dm_apply fast paths against the list-based reference path)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from doorman_tpu.solver.batch import BatchSolver
+
+    clock = FakeClock(500.0)
+    py_res = _make_resources(None, clock)
+    engine = native.StoreEngine(clock=clock)
+    cc_res = _make_resources(engine.store, clock)
+
+    solver_py = BatchSolver(clock=clock)
+    solver_cc = BatchSolver(clock=clock)
+    grants_py = solver_py.tick(py_res)
+    grants_cc = solver_cc.tick(cc_res)
+    assert grants_py == grants_cc
+    for a, b in zip(py_res, cc_res):
+        assert a.store.sum_has == pytest.approx(b.store.sum_has)
+        assert a.store.sum_wants == pytest.approx(b.store.sum_wants)
+        assert dict(a.store.items()) == dict(b.store.items())
+
+
+def test_batch_apply_native_skips_released_and_vanished():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from doorman_tpu.solver.batch import BatchSolver
+
+    clock = FakeClock(500.0)
+    engine = native.StoreEngine(clock=clock)
+    resources = _make_resources(engine.store, clock, n_resources=3)
+    solver = BatchSolver(clock=clock)
+    snap = solver.prepare(resources)
+    gets = solver.solve(snap)
+    # Mid-solve: one client releases, one resource vanishes.
+    victim = next(iter(dict(resources[0].store.items())))
+    resources[0].store.release(victim)
+    dropped = resources.pop(1)
+    grants = solver.apply(resources, snap, gets)
+    assert victim not in grants.get("res0", {})
+    assert dropped.id not in grants
+    assert not dropped.store.has_client("client-0") or all(
+        l.expiry <= 560.0 for _, l in dropped.store.items()
+    )  # vanished resource got no fresh expiry stamps
+
+
+def test_server_with_native_store():
+    """The end-to-end server path on the native engine: grant, then a
+    mastership reset wipes engine state."""
+    import asyncio
+
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.server import config as config_mod
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    async def scenario():
+        clock = FakeClock(1000.0)
+        server = CapacityServer(
+            "s1", TrivialElection(), minimum_refresh_interval=0.0,
+            clock=clock, native_store=True,
+        )
+        assert server._store_factory is not None
+        yaml_text = """
+resources:
+  - identifier_glob: "*"
+    capacity: 100
+    algorithm:
+      kind: PROPORTIONAL_SHARE
+      lease_length: 60
+      refresh_interval: 5
+"""
+        await server.load_config(config_mod.parse_yaml_config(yaml_text))
+        await server._on_is_master(True)
+        server.became_master_at = clock() - 1000  # past learning mode
+
+        req = pb.GetCapacityRequest()
+        r = req.resource.add()
+        r.resource_id = "res0"
+        r.priority = 1
+        r.wants = 50.0
+        r.has.expiry_time = 0
+        req.client_id = "client-a"
+        resp = await server.GetCapacity(req, None)
+        assert resp.response[0].gets.capacity == 50.0
+        res = server.resources["res0"]
+        assert type(res.store).__name__ == "NativeLeaseStore"
+        assert res.store.sum_wants == 50.0
+
+        # Mastership loss wipes the native engine state.
+        await server._on_is_master(False)
+        await server._on_is_master(True)
+        assert server.resources == {}
+
+    asyncio.run(scenario())
