@@ -151,16 +151,26 @@ def waterfill_level(
     wants_sorted = wants[order]
     # After the first k clients saturate (get their wants), the rest share
     # the remainder at level L = remaining / remaining_weight; L is valid
-    # when r[k-1] <= L <= r[k].
+    # when r[k-1] <= L <= r[k]. Zero-weight clients sort last (infinite
+    # ratio) and can absorb no water: once the weighted clients are all
+    # saturated, the level is the largest finite saturation ratio — NOT
+    # zero, which would wrongly zero the already-saturated grants.
     remaining = capacity
     remaining_weight = float(np.sum(w_sorted))
+    last_ratio = 0.0
     for k in range(len(r)):
-        level = remaining / remaining_weight if remaining_weight > 0 else 0.0
+        if remaining_weight <= 0:
+            return last_ratio
+        level = remaining / remaining_weight
         if level <= r[k]:
             return level
         remaining -= wants_sorted[k]
         remaining_weight -= w_sorted[k]
-    return remaining / remaining_weight if remaining_weight > 0 else 0.0
+        if np.isfinite(r[k]):
+            last_ratio = float(r[k])
+    return (
+        remaining / remaining_weight if remaining_weight > 0 else last_ratio
+    )
 
 
 def fair_share_waterfill(

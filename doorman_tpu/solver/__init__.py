@@ -21,3 +21,7 @@ from doorman_tpu.solver.dense import (  # noqa: F401
 )
 from doorman_tpu.solver.fairshare import waterfill_levels  # noqa: F401
 from doorman_tpu.solver.pallas_dense import solve_dense_pallas  # noqa: F401
+from doorman_tpu.solver.priority import (  # noqa: F401
+    PriorityBatch,
+    solve_priority,
+)

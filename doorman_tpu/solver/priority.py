@@ -1,0 +1,128 @@
+"""Batched priority-banded, group-capped solve (BASELINE.json config 5).
+
+Device recast of doorman_tpu.algorithms.priority: all resources at once
+in the dense bucket layout, with
+
+  * lexicographic priority bands — a static loop over band ranks, each
+    band water-filled (the shared bisection+snap level finder from
+    solver.lanes) within the capacity higher bands left over;
+  * cross-resource group caps — per-group theta in [0, 1] scaling the
+    member resources' capacities, found by an outer bisection
+    (`lax.fori_loop`); usage is monotone in theta so the fixpoint is
+    exact to the bisection depth.
+
+Band ranks are dense per resource (0 = highest); the host maps raw int64
+wire priorities (doorman.proto ResourceRequest.priority) to ranks when
+packing — on device everything is static shapes and bounded loops.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from doorman_tpu.solver.lanes import waterfill_level
+
+THETA_ITERS = 64  # matches algorithms.priority.THETA_ITERS
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PriorityBatch:
+    """Dense bucket layout: R resources x up to K clients, plus groups."""
+
+    wants: jax.Array  # [R, K]
+    weights: jax.Array  # [R, K] (subclients)
+    band: jax.Array  # [R, K] int32 dense rank, 0 = highest
+    active: jax.Array  # [R, K] bool
+    capacity: jax.Array  # [R]
+    group: jax.Array  # [R] int32 group id, -1 = uncoupled
+    group_cap: jax.Array  # [G]
+
+
+def _alloc_banded(
+    wants, weights, band, active, capacity, num_bands: int
+):
+    """Grants [R, K] for given per-resource capacities: bands in rank
+    order, each water-filled in the remainder."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    segsum = lambda v: v.sum(axis=1)
+    segmax = lambda v: v.max(axis=1)
+    expand = lambda t: t[:, None]
+
+    def one_band(carry, rank):
+        gets, remaining = carry
+        m = active & (band == rank)
+        w = jnp.where(m, wants, zero)
+        wt = jnp.where(m, weights, zero)
+        level = waterfill_level(
+            w, wt, m, remaining, segsum, segmax, expand
+        )
+        fits = expand(segsum(w) <= remaining)
+        share = jnp.where(
+            fits, w, jnp.minimum(w, expand(level) * wt)
+        )
+        share = jnp.where(m, share, zero)
+        remaining = jnp.maximum(remaining - segsum(share), 0.0)
+        return (gets + share, remaining), None
+
+    init = (jnp.zeros_like(wants), capacity)
+    (gets, _), _ = jax.lax.scan(
+        one_band, init, jnp.arange(num_bands, dtype=jnp.int32)
+    )
+    return gets
+
+
+@functools.partial(jax.jit, static_argnames=("num_bands",))
+def solve_priority(batch: PriorityBatch, num_bands: int = 4) -> jax.Array:
+    """Grants [R, K]; matches algorithms.priority.grouped_priority_alloc.
+
+    `num_bands` bounds the band loop (host packs dense ranks < num_bands;
+    edges with band >= num_bands are never served)."""
+    dtype = batch.wants.dtype
+    wants = jnp.where(batch.active, batch.wants, 0.0).astype(dtype)
+    weights = jnp.where(batch.active, batch.weights, 0.0).astype(dtype)
+    G = batch.group_cap.shape[0]
+    if G == 0:
+        # No cross-resource caps configured: a single banded pass.
+        return _alloc_banded(
+            wants, weights, batch.band, batch.active, batch.capacity,
+            num_bands,
+        )
+    grouped = batch.group >= 0
+    # Gather index clamped for uncoupled resources (group id -1).
+    gidx = jnp.where(grouped, batch.group, 0)
+
+    def usage_per_group(theta_g):  # [G] -> [G]
+        theta_r = jnp.where(grouped, theta_g[gidx], 1.0)
+        gets = _alloc_banded(
+            wants, weights, batch.band, batch.active,
+            batch.capacity * theta_r, num_bands,
+        )
+        per_resource = gets.sum(axis=1)
+        return jax.ops.segment_sum(
+            jnp.where(grouped, per_resource, 0.0), gidx, num_segments=G
+        )
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) * 0.5
+        feasible = usage_per_group(mid) <= batch.group_cap
+        return jnp.where(feasible, mid, lo), jnp.where(feasible, hi, mid)
+
+    lo = jnp.zeros(G, dtype)
+    hi = jnp.ones(G, dtype)
+    # theta = 1 feasible => skip straight to 1 (matches the oracle's
+    # early-out, which never bisects a group that already fits).
+    fits_at_one = usage_per_group(hi) <= batch.group_cap
+    lo, hi = jax.lax.fori_loop(0, THETA_ITERS, body, (lo, hi))
+    theta_g = jnp.where(fits_at_one, 1.0, lo)
+    theta_r = jnp.where(grouped, theta_g[gidx], 1.0)
+    return _alloc_banded(
+        wants, weights, batch.band, batch.active,
+        batch.capacity * theta_r, num_bands,
+    )

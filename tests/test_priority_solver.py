@@ -1,0 +1,197 @@
+"""Priority-banded group-capped allocation: oracle properties and
+JAX-vs-oracle parity (BASELINE.json config 5)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from doorman_tpu.algorithms import priority as oracle
+from doorman_tpu.algorithms.tick import fair_share_waterfill
+from doorman_tpu.solver.priority import PriorityBatch, solve_priority
+
+jax.config.update("jax_enable_x64", True)
+
+
+# ---------------------------------------------------------------- oracle
+
+def test_single_band_is_fair_share():
+    rng = np.random.default_rng(0)
+    wants = rng.integers(0, 100, 20).astype(float)
+    weights = rng.integers(1, 4, 20).astype(float)
+    got = oracle.priority_alloc(300.0, wants, weights, np.zeros(20, int))
+    np.testing.assert_allclose(
+        got, fair_share_waterfill(300.0, wants, weights)
+    )
+
+
+def test_higher_band_served_first():
+    wants = np.array([50.0, 50.0, 80.0, 80.0])
+    weights = np.ones(4)
+    bands = np.array([0, 0, 1, 1])
+    got = oracle.priority_alloc(120.0, wants, weights, bands)
+    # Band 0 fits entirely (100), band 1 splits the 20 left over.
+    np.testing.assert_allclose(got, [50, 50, 10, 10])
+    # Capacity below band 0's demand: band 1 gets nothing.
+    got = oracle.priority_alloc(60.0, wants, weights, bands)
+    np.testing.assert_allclose(got, [30, 30, 0, 0])
+
+
+def test_oracle_capacity_invariant():
+    rng = np.random.default_rng(1)
+    for _ in range(20):
+        n = int(rng.integers(1, 30))
+        wants = rng.integers(0, 100, n).astype(float)
+        weights = rng.integers(1, 5, n).astype(float)
+        bands = rng.integers(0, 4, n)
+        cap = float(rng.integers(1, 600))
+        got = oracle.priority_alloc(cap, wants, weights, bands)
+        assert got.sum() <= cap + 1e-9
+        assert (got <= wants + 1e-12).all()
+        assert (got >= -1e-12).all()
+
+
+def test_group_cap_binds():
+    # Two resources, each capacity 100, sharing a group cap of 120.
+    wants = [np.full(4, 50.0), np.full(4, 50.0)]
+    weights = [np.ones(4), np.ones(4)]
+    bands = [np.zeros(4, int), np.zeros(4, int)]
+    got = oracle.grouped_priority_alloc(
+        np.array([100.0, 100.0]), wants, weights, bands,
+        np.array([0, 0]), np.array([120.0]),
+    )
+    total = sum(g.sum() for g in got)
+    assert total == pytest.approx(120.0, rel=1e-6)
+    # Symmetric inputs split evenly.
+    np.testing.assert_allclose(got[0], got[1])
+
+
+def test_uncoupled_resource_ignores_groups():
+    wants = [np.full(4, 50.0), np.full(4, 50.0)]
+    weights = [np.ones(4), np.ones(4)]
+    bands = [np.zeros(4, int), np.zeros(4, int)]
+    got = oracle.grouped_priority_alloc(
+        np.array([100.0, 100.0]), wants, weights, bands,
+        np.array([0, -1]), np.array([80.0]),
+    )
+    assert got[0].sum() == pytest.approx(80.0, rel=1e-6)
+    assert got[1].sum() == pytest.approx(100.0, rel=1e-6)
+
+
+def test_zero_weight_client_parity():
+    """A zero-weight active client absorbs no water; the saturated
+    weighted clients keep their grants (regression: the oracle's level
+    finder used to collapse to 0 once weighted clients were
+    exhausted)."""
+    wants = np.array([60.0, 60.0])
+    weights = np.array([1.0, 0.0])
+    bands = np.zeros(2, int)
+    got = oracle.priority_alloc(100.0, wants, weights, bands)
+    np.testing.assert_allclose(got, [60.0, 0.0])
+    batch = PriorityBatch(
+        wants=jnp.asarray(wants)[None, :],
+        weights=jnp.asarray(weights)[None, :],
+        band=jnp.asarray(bands, jnp.int32)[None, :],
+        active=jnp.ones((1, 2), bool),
+        capacity=jnp.asarray([100.0]),
+        group=jnp.asarray([-1], jnp.int32),
+        group_cap=jnp.zeros(0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(solve_priority(batch, num_bands=1))[0], [60.0, 0.0]
+    )
+    # All weights zero: nobody can be served in overload.
+    got = oracle.priority_alloc(100.0, wants, np.zeros(2), bands)
+    np.testing.assert_allclose(got, [0.0, 0.0])
+
+
+def test_no_groups_configured():
+    """group_cap of shape [0] (the base case) must not crash and must
+    equal the per-resource banded allocation."""
+    rng = np.random.default_rng(4)
+    active, wants, weights, band, capacity, _, _ = _random_case(rng)
+    R = len(capacity)
+    batch = PriorityBatch(
+        wants=jnp.asarray(wants), weights=jnp.asarray(weights),
+        band=jnp.asarray(band), active=jnp.asarray(active),
+        capacity=jnp.asarray(capacity),
+        group=jnp.full(R, -1, jnp.int32),
+        group_cap=jnp.zeros(0),
+    )
+    got = np.asarray(solve_priority(batch, num_bands=4))
+    for r in range(R):
+        np.testing.assert_allclose(
+            got[r, active[r]],
+            oracle.priority_alloc(
+                capacity[r], wants[r, active[r]], weights[r, active[r]],
+                band[r, active[r]],
+            ),
+            rtol=1e-9, atol=1e-6,
+        )
+
+
+# ---------------------------------------------------------------- parity
+
+def _random_case(rng, R=12, K=32, G=3, num_bands=4):
+    active = np.zeros((R, K), bool)
+    for r in range(R):
+        active[r, : rng.integers(1, K + 1)] = True
+    wants = (rng.integers(0, 100, (R, K)) * active).astype(np.float64)
+    weights = (rng.integers(1, 4, (R, K)) * active).astype(np.float64)
+    band = (rng.integers(0, num_bands, (R, K)) * active).astype(np.int32)
+    capacity = rng.integers(50, 800, R).astype(np.float64)
+    group = rng.choice(np.arange(-1, G), R).astype(np.int32)
+    group_cap = rng.integers(100, 1200, G).astype(np.float64)
+    return active, wants, weights, band, capacity, group, group_cap
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_jax_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    active, wants, weights, band, capacity, group, group_cap = _random_case(
+        rng
+    )
+    batch = PriorityBatch(
+        wants=jnp.asarray(wants),
+        weights=jnp.asarray(weights),
+        band=jnp.asarray(band),
+        active=jnp.asarray(active),
+        capacity=jnp.asarray(capacity),
+        group=jnp.asarray(group),
+        group_cap=jnp.asarray(group_cap),
+    )
+    got = np.asarray(solve_priority(batch, num_bands=4))
+
+    expected_rows = oracle.grouped_priority_alloc(
+        capacity,
+        [wants[r, active[r]] for r in range(len(capacity))],
+        [weights[r, active[r]] for r in range(len(capacity))],
+        [band[r, active[r]] for r in range(len(capacity))],
+        group,
+        group_cap,
+    )
+    for r in range(len(capacity)):
+        np.testing.assert_allclose(
+            got[r, active[r]], expected_rows[r], rtol=1e-9, atol=1e-6,
+            err_msg=f"resource {r}",
+        )
+    assert (got[~active] == 0).all()
+
+
+def test_jax_group_caps_respected():
+    rng = np.random.default_rng(9)
+    active, wants, weights, band, capacity, group, group_cap = _random_case(
+        rng, R=20, G=4
+    )
+    batch = PriorityBatch(
+        wants=jnp.asarray(wants), weights=jnp.asarray(weights),
+        band=jnp.asarray(band), active=jnp.asarray(active),
+        capacity=jnp.asarray(capacity), group=jnp.asarray(group),
+        group_cap=jnp.asarray(group_cap),
+    )
+    got = np.asarray(solve_priority(batch, num_bands=4))
+    per_resource = got.sum(axis=1)
+    for g in range(len(group_cap)):
+        usage = per_resource[group == g].sum()
+        assert usage <= group_cap[g] * (1 + 1e-9) + 1e-6
+    assert (per_resource <= capacity + 1e-6).all()
