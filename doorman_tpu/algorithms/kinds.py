@@ -18,3 +18,7 @@ class AlgoKind(enum.IntEnum):
     # The Go-style "equal share + proportional top-up" variant
     # (reference algorithm.go:213-292) in snapshot form.
     PROPORTIONAL_TOPUP = 4
+    # Priority-banded weighted max-min with capacity groups (wire kind
+    # PRIORITY_BANDS = 4 maps here; the solve_lanes kernels do not carry
+    # this lane — BatchSolver routes it to solver.priority instead).
+    PRIORITY_BANDS = 5

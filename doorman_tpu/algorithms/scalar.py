@@ -37,6 +37,7 @@ class Request:
     has: float
     wants: float
     subclients: int = 1
+    priority: int = 0
 
 
 def _params(config: pb.Algorithm) -> tuple[float, float]:
@@ -48,7 +49,8 @@ def no_algorithm(config: pb.Algorithm) -> Algorithm:
     length, interval = _params(config)
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
-        return store.assign(r.client, length, interval, r.wants, r.wants, r.subclients)
+        return store.assign(r.client, length, interval, r.wants, r.wants, r.subclients,
+                            priority=r.priority)
 
     return algo
 
@@ -60,7 +62,8 @@ def static(config: pb.Algorithm) -> Algorithm:
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
         return store.assign(
-            r.client, length, interval, min(capacity, r.wants), r.wants, r.subclients
+            r.client, length, interval, min(capacity, r.wants), r.wants,
+            r.subclients, priority=r.priority,
         )
 
     return algo
@@ -72,7 +75,8 @@ def learn(config: pb.Algorithm) -> Algorithm:
     length, interval = _params(config)
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
-        return store.assign(r.client, length, interval, r.has, r.wants, r.subclients)
+        return store.assign(r.client, length, interval, r.has, r.wants, r.subclients,
+                            priority=r.priority)
 
     return algo
 
@@ -95,7 +99,8 @@ def proportional_share(config: pb.Algorithm) -> Algorithm:
             gets = min(r.wants, free)
         else:
             gets = min(r.wants * (capacity / all_wants), free)
-        return store.assign(r.client, length, interval, gets, r.wants, r.subclients)
+        return store.assign(r.client, length, interval, gets, r.wants, r.subclients,
+                            priority=r.priority)
 
     return algo
 
@@ -124,6 +129,7 @@ def proportional_topup(config: pb.Algorithm) -> Algorithm:
             return store.assign(
                 r.client, length, interval,
                 min(r.wants, unused), r.wants, r.subclients,
+                priority=r.priority,
             )
 
         # Overload: pool the capacity left by clients under their equal
@@ -146,7 +152,8 @@ def proportional_topup(config: pb.Algorithm) -> Algorithm:
             extra_capacity / extra_need
         )
         return store.assign(
-            r.client, length, interval, min(gets, unused), r.wants, r.subclients
+            r.client, length, interval, min(gets, unused), r.wants,
+            r.subclients, priority=r.priority,
         )
 
     return algo
@@ -176,6 +183,7 @@ def fair_share(config: pb.Algorithm) -> Algorithm:
             return store.assign(
                 r.client, length, interval,
                 min(r.wants, available), r.wants, r.subclients,
+                priority=r.priority,
             )
 
         # Round 1: capacity left by clients under their equal share is
@@ -198,6 +206,7 @@ def fair_share(config: pb.Algorithm) -> Algorithm:
             return store.assign(
                 r.client, length, interval,
                 min(r.wants, available), r.wants, r.subclients,
+                priority=r.priority,
             )
 
         # Round 2: clients over their equal share but under share+extra
@@ -217,7 +226,53 @@ def fair_share(config: pb.Algorithm) -> Algorithm:
         return store.assign(
             r.client, length, interval,
             min(deserved + deserved_extra + deserved_extra_extra, available),
-            r.wants, r.subclients,
+            r.wants, r.subclients, priority=r.priority,
+        )
+
+    return algo
+
+
+def priority_bands(config: pb.Algorithm) -> Algorithm:
+    """Priority-banded weighted max-min (the scalar form of
+    doorman_tpu.solver.priority): recompute the whole resource's
+    allocation — every stored lease plus this request — with clients
+    served in descending wire-priority bands, and grant the requester its
+    share. Cross-resource capacity groups are enforced by the batched
+    tick solve only; this per-request form sees one resource at a time."""
+    import numpy as np
+
+    from doorman_tpu.algorithms.priority import priority_alloc
+
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        entries = {
+            c: (l.wants, float(l.subclients), l.priority)
+            for c, l in store.items()
+        }
+        entries[r.client] = (r.wants, float(r.subclients), r.priority)
+        clients = list(entries)
+        wants = np.array([entries[c][0] for c in clients], np.float64)
+        weights = np.array([entries[c][1] for c in clients], np.float64)
+        prios = [entries[c][2] for c in clients]
+        # Dense band ranks: larger wire priority = more important = lower
+        # band index.
+        levels = sorted(set(prios), reverse=True)
+        rank = {p: i for i, p in enumerate(levels)}
+        bands = np.array([rank[p] for p in prios], np.int64)
+        gets = priority_alloc(capacity, wants, weights, bands)
+        # Only the requester's lease is reassigned here, so clamp to the
+        # capacity not promised to others — a preempting high-priority
+        # client converges as the displaced leases refresh (the same
+        # incremental discipline as the other scalar forms; the batched
+        # tick reassigns everyone at once and needs no clamp).
+        available = max(
+            capacity - store.sum_has + store.get(r.client).has, 0.0
+        )
+        grant = min(float(gets[clients.index(r.client)]), available)
+        return store.assign(
+            r.client, length, interval, grant, r.wants, r.subclients,
+            priority=r.priority,
         )
 
     return algo
@@ -237,6 +292,7 @@ _FACTORIES = {
     pb.Algorithm.STATIC: static,
     pb.Algorithm.PROPORTIONAL_SHARE: proportional_share,
     pb.Algorithm.FAIR_SHARE: fair_share,
+    pb.Algorithm.PRIORITY_BANDS: priority_bands,
 }
 
 

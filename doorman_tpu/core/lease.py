@@ -23,6 +23,10 @@ class Lease:
     has: float = 0.0
     wants: float = 0.0
     subclients: int = 0
+    # Wire priority of the client for this resource (doorman.proto
+    # ResourceRequest.priority); interpreted only by priority-aware
+    # algorithms, recorded for all.
+    priority: int = 0
 
     @property
     def is_zero(self) -> bool:

@@ -22,12 +22,16 @@ from doorman_tpu.algorithms.kinds import AlgoKind
 
 def algo_kind_for(template: pb.ResourceTemplate) -> int:
     """Map a config template to the solver lane. PROPORTIONAL_SHARE with
-    parameter variant=topup selects the Go-style top-up lane."""
+    parameter variant=topup selects the Go-style top-up lane; the wire
+    PRIORITY_BANDS kind maps to its internal lane id (the wire value
+    collides with the internal top-up lane number)."""
     kind = int(template.algorithm.kind)
     if kind == int(pb.Algorithm.PROPORTIONAL_SHARE) and (
         scalar.get_parameter(template.algorithm, "variant") == "topup"
     ):
         return int(AlgoKind.PROPORTIONAL_TOPUP)
+    if kind == int(pb.Algorithm.PRIORITY_BANDS):
+        return int(AlgoKind.PRIORITY_BANDS)
     return kind
 
 

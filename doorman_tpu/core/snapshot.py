@@ -63,6 +63,9 @@ class Snapshot:
     engine: object = None
     ridx: "np.ndarray | None" = None  # [num_edges] segment per edge
     cids: "np.ndarray | None" = None  # [num_edges] client handles
+    # PRIORITY_BANDS resources ride in their own dense part (built and
+    # consumed by BatchSolver; None when the tick has none).
+    priority_part: object = None
 
     def keys(self) -> List[Tuple[str, str]]:
         """(resource_id, client_id) per packed edge, either flavor."""

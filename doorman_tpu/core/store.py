@@ -79,6 +79,7 @@ class LeaseStore:
         has: float,
         wants: float,
         subclients: int,
+        priority: int = 0,
     ) -> Lease:
         """Record capacity `has` given to `client`; updates running sums by
         delta and stamps a fresh expiry of now + lease_length."""
@@ -92,6 +93,7 @@ class LeaseStore:
             has=has,
             wants=wants,
             subclients=subclients,
+            priority=priority,
         )
         self._leases[client] = lease
         return lease

@@ -73,6 +73,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_assign.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+        ctypes.c_int64,
     ]
     lib.dm_release.restype = ctypes.c_int32
     lib.dm_release.argtypes = [ctypes.c_void_p, ctypes.c_int32,
@@ -87,19 +88,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_dump.restype = ctypes.c_int64
     lib.dm_dump.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, _I64P, _F64P, _F64P, _F64P, _F64P,
-        _I32P, ctypes.c_int64,
+        _I32P, _I64P, ctypes.c_int64,
     ]
     lib.dm_total_leases.restype = ctypes.c_int64
     lib.dm_total_leases.argtypes = [ctypes.c_void_p]
     lib.dm_pack.restype = ctypes.c_int64
     lib.dm_pack.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P, _F64P,
-        _F64P, ctypes.c_int64,
+        _F64P, _I64P, ctypes.c_int64,
     ]
     lib.dm_apply.restype = ctypes.c_int64
     lib.dm_apply.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P,
         ctypes.c_int64, _F64P, _F64P, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
     ]
 
 
@@ -175,27 +177,30 @@ class StoreEngine:
     def total_leases(self) -> int:
         return self._lib.dm_total_leases(self._ptr)
 
-    def pack(
-        self, order: List["NativeLeaseStore"]
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    def pack(self, order: List["NativeLeaseStore"]) -> Tuple[
+        np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+        np.ndarray,
+    ]:
         """Resource-major edge dump following `order`: returns
-        (ridx, cid, wants, has, subclients) with ridx the position of the
-        edge's resource in `order` — the solver's segment id."""
+        (ridx, cid, wants, has, subclients, priority) with ridx the
+        position of the edge's resource in `order` — the solver's
+        segment id."""
         cap = self._lib.dm_total_leases(self._ptr)
         ridx = np.empty(cap, np.int32)
         cid = np.empty(cap, np.int64)
         wants = np.empty(cap, np.float64)
         has = np.empty(cap, np.float64)
         sub = np.empty(cap, np.float64)
+        prio = np.empty(cap, np.int64)
         handles = np.asarray([s._rid for s in order], np.int32)
         n = self._lib.dm_pack(
             self._ptr,
             handles.ctypes.data_as(_I32P), len(order),
             ridx.ctypes.data_as(_I32P), cid.ctypes.data_as(_I64P),
             wants.ctypes.data_as(_F64P), has.ctypes.data_as(_F64P),
-            sub.ctypes.data_as(_F64P), cap,
+            sub.ctypes.data_as(_F64P), prio.ctypes.data_as(_I64P), cap,
         )
-        return ridx[:n], cid[:n], wants[:n], has[:n], sub[:n]
+        return ridx[:n], cid[:n], wants[:n], has[:n], sub[:n], prio[:n]
 
     def apply(
         self,
@@ -205,23 +210,31 @@ class StoreEngine:
         gets: np.ndarray,  # [E]
         expiry: np.ndarray,  # [n_seg] absolute expiry stamps
         refresh: np.ndarray,  # [n_seg]
+        keep_has: "np.ndarray | None" = None,  # [n_seg] bool: refresh only
     ) -> np.ndarray:
         """Bulk grant write-back; returns a bool mask of edges applied
-        (False: client released or resource gone mid-solve)."""
+        (False: client released or resource gone mid-solve). Segments
+        flagged in keep_has refresh expiries but leave has untouched
+        (learning mode)."""
         order_rids = np.ascontiguousarray(order_rids, np.int32)
         ridx = np.ascontiguousarray(ridx, np.int32)
         cid = np.ascontiguousarray(cid, np.int64)
         gets = np.ascontiguousarray(gets, np.float64)
         expiry = np.ascontiguousarray(expiry, np.float64)
         refresh = np.ascontiguousarray(refresh, np.float64)
+        if keep_has is None:
+            keep_has = np.zeros(len(order_rids), np.uint8)
+        keep_has = np.ascontiguousarray(keep_has, np.uint8)
         applied = np.zeros(len(ridx), np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
         self._lib.dm_apply(
             self._ptr,
             order_rids.ctypes.data_as(_I32P), len(order_rids),
             ridx.ctypes.data_as(_I32P), cid.ctypes.data_as(_I64P),
             gets.ctypes.data_as(_F64P), len(ridx),
             expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
-            applied.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            keep_has.ctypes.data_as(u8p),
+            applied.ctypes.data_as(u8p),
         )
         return applied.astype(bool)
 
@@ -240,7 +253,7 @@ class NativeLeaseStore:
         self._ptr = engine._ptr
         self._rid = rid
         self._clock = engine._clock
-        self._out = np.empty(5, np.float64)  # dm_get scratch
+        self._out = np.empty(6, np.float64)  # dm_get scratch
 
     def _sums(self) -> np.ndarray:
         out = np.empty(4, np.float64)
@@ -269,9 +282,9 @@ class NativeLeaseStore:
         )
         if not ok:
             return ZERO_LEASE
-        e, r, h, w, s = self._out
+        e, r, h, w, s, p = self._out
         return Lease(expiry=e, refresh_interval=r, has=h, wants=w,
-                     subclients=int(s))
+                     subclients=int(s), priority=int(p))
 
     def has_client(self, client: str) -> bool:
         return bool(self._lib.dm_get(
@@ -290,14 +303,16 @@ class NativeLeaseStore:
         has: float,
         wants: float,
         subclients: int,
+        priority: int = 0,
     ) -> Lease:
         expiry = self._clock() + lease_length
         self._lib.dm_assign(
             self._ptr, self._rid, self._engine.client_handle(client),
-            expiry, refresh_interval, has, wants, subclients,
+            expiry, refresh_interval, has, wants, subclients, priority,
         )
         return Lease(expiry=expiry, refresh_interval=refresh_interval,
-                     has=has, wants=wants, subclients=subclients)
+                     has=has, wants=wants, subclients=subclients,
+                     priority=priority)
 
     def release(self, client: str) -> None:
         self._lib.dm_release(
@@ -315,16 +330,18 @@ class NativeLeaseStore:
         has = np.empty(n, np.float64)
         wants = np.empty(n, np.float64)
         sub = np.empty(n, np.int32)
+        prio = np.empty(n, np.int64)
         n = self._lib.dm_dump(
             self._ptr, self._rid, cids.ctypes.data_as(_I64P),
             expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
             has.ctypes.data_as(_F64P), wants.ctypes.data_as(_F64P),
-            sub.ctypes.data_as(_I32P), n,
+            sub.ctypes.data_as(_I32P), prio.ctypes.data_as(_I64P), n,
         )
-        return cids[:n], expiry[:n], refresh[:n], has[:n], wants[:n], sub[:n]
+        return (cids[:n], expiry[:n], refresh[:n], has[:n], wants[:n],
+                sub[:n], prio[:n])
 
     def items(self) -> Iterator[Tuple[str, Lease]]:
-        cids, expiry, refresh, has, wants, sub = self._dump()
+        cids, expiry, refresh, has, wants, sub, prio = self._dump()
         name = self._engine.client_name
         for i in range(len(cids)):
             yield name(int(cids[i])), Lease(
@@ -333,6 +350,7 @@ class NativeLeaseStore:
                 has=float(has[i]),
                 wants=float(wants[i]),
                 subclients=int(sub[i]),
+                priority=int(prio[i]),
             )
 
     def map(self, fn: Callable[[str, Lease], None]) -> None:

@@ -32,6 +32,7 @@ struct Lease {
   double has;
   double wants;
   int32_t subclients;
+  int64_t priority;
 };
 
 struct ResourceStore {
@@ -97,9 +98,10 @@ int64_t dm_client(Engine *e, const char *id) {
 // already held a lease, 0 if this is a new entry.
 int32_t dm_assign(Engine *e, int32_t rid, int64_t cid, double expiry,
                   double refresh_interval, double has, double wants,
-                  int32_t subclients) {
+                  int32_t subclients, int64_t priority) {
   ResourceStore &r = e->resources[rid];
-  const Lease fresh{expiry, refresh_interval, has, wants, subclients};
+  const Lease fresh{expiry, refresh_interval, has, wants, subclients,
+                    priority};
   auto it = r.index.find(cid);
   if (it == r.index.end()) {
     r.index.emplace(cid, r.clients.size());
@@ -153,7 +155,7 @@ void dm_sums(Engine *e, int32_t rid, double *out) {
 }
 
 // Fetch one lease: out = {expiry, refresh_interval, has, wants,
-// subclients}. Returns 1 if present, else 0 (out untouched).
+// subclients, priority}. Returns 1 if present, else 0 (out untouched).
 int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
   const ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
@@ -164,6 +166,7 @@ int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
   out[2] = l.has;
   out[3] = l.wants;
   out[4] = l.subclients;
+  out[5] = static_cast<double>(l.priority);
   return 1;
 }
 
@@ -171,7 +174,7 @@ int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
 // dm_sums(...)[3] entries; returns the number written.
 int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
                 double *refresh, double *has, double *wants,
-                int32_t *subclients, int64_t cap) {
+                int32_t *subclients, int64_t *priority, int64_t cap) {
   const ResourceStore &r = e->resources[rid];
   const int64_t n =
       std::min<int64_t>(cap, static_cast<int64_t>(r.leases.size()));
@@ -183,6 +186,7 @@ int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
     has[i] = l.has;
     wants[i] = l.wants;
     subclients[i] = l.subclients;
+    priority[i] = l.priority;
   }
   return n;
 }
@@ -200,7 +204,8 @@ int64_t dm_total_leases(Engine *e) {
 // the engine handle. Returns edges written (<= cap).
 int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
                 int32_t *ridx_out, int64_t *cid_out, double *wants_out,
-                double *has_out, double *sub_out, int64_t cap) {
+                double *has_out, double *sub_out, int64_t *prio_out,
+                int64_t cap) {
   int64_t w = 0;
   for (int32_t i = 0; i < n_order; ++i) {
     const ResourceStore &r = e->resources[order[i]];
@@ -213,6 +218,7 @@ int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
       wants_out[w] = l.wants;
       has_out[w] = l.has;
       sub_out[w] = l.subclients;
+      prio_out[w] = l.priority;
       ++w;
     }
   }
@@ -221,16 +227,18 @@ int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
 
 // Bulk grant write-back after a solve: for each edge, if the client
 // still holds a lease, set has=gets and stamp the segment's fresh
-// expiry/refresh; wants/subclients keep their CURRENT store values so
-// demand that changed while the solve was in flight is preserved (same
-// semantics as BatchSolver.apply). order[seg] < 0 skips that segment
-// (its resource vanished mid-solve). applied_out[i] is 1 where the edge
-// was written. Returns the number applied.
+// expiry/refresh; wants/subclients/priority keep their CURRENT store
+// values so demand that changed while the solve was in flight is
+// preserved (same semantics as BatchSolver.apply). order[seg] < 0 skips
+// that segment (its resource vanished mid-solve); keep_has[seg] != 0
+// refreshes the lease but leaves has untouched (learning-mode resources
+// replay the reported grant). applied_out[i] is 1 where the edge was
+// written. Returns the number applied.
 int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
                  const int32_t *ridx, const int64_t *cid,
                  const double *gets, int64_t n_edges,
                  const double *expiry, const double *refresh,
-                 uint8_t *applied_out) {
+                 const uint8_t *keep_has, uint8_t *applied_out) {
   int64_t applied = 0;
   for (int64_t i = 0; i < n_edges; ++i) {
     applied_out[i] = 0;
@@ -240,8 +248,10 @@ int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
     auto it = r.index.find(cid[i]);
     if (it == r.index.end()) continue;  // released mid-solve
     Lease &l = r.leases[it->second];
-    r.sum_has += gets[i] - l.has;
-    l.has = gets[i];
+    if (!keep_has[seg]) {
+      r.sum_has += gets[i] - l.has;
+      l.has = gets[i];
+    }
     l.expiry = expiry[seg];
     l.refresh_interval = refresh[seg];
     applied_out[i] = 1;

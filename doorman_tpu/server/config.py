@@ -94,6 +94,17 @@ def validate_algorithm(algo: pb.Algorithm) -> None:
 
 def validate_repository(repo: pb.ResourceRepository) -> None:
     """Validate a ResourceRepository; raises ConfigError when invalid."""
+    groups = set()
+    for grp in repo.groups:
+        if not grp.name:
+            raise ConfigError("capacity group without a name")
+        if grp.name in groups:
+            raise ConfigError(f"duplicate capacity group {grp.name!r}")
+        if grp.capacity < 0:
+            raise ConfigError(
+                f"capacity group {grp.name!r} has negative capacity"
+            )
+        groups.add(grp.name)
     star_found = False
     for i, tpl in enumerate(repo.resources):
         glob = tpl.identifier_glob
@@ -105,6 +116,21 @@ def validate_repository(repo: pb.ResourceRepository) -> None:
         has_algo = tpl.HasField("algorithm")
         if has_algo:
             validate_algorithm(tpl.algorithm)
+        if tpl.HasField("capacity_group"):
+            if tpl.capacity_group not in groups:
+                raise ConfigError(
+                    f"template {glob!r} references undefined capacity "
+                    f"group {tpl.capacity_group!r}"
+                )
+            if (
+                not has_algo
+                or tpl.algorithm.kind != pb.Algorithm.PRIORITY_BANDS
+            ):
+                raise ConfigError(
+                    f"template {glob!r}: capacity_group requires the "
+                    "PRIORITY_BANDS algorithm (groups are enforced by "
+                    "the batched priority solve)"
+                )
         if glob == "*":
             if not has_algo:
                 raise ConfigError('the entry for "*" must specify an algorithm')

@@ -196,6 +196,7 @@ class CapacityServer(CapacityServicer):
         config_mod.validate_repository(repo)
         first_time = self.config is None
         self.config = repo
+        self._push_groups()
         if first_time:
             self.is_configured.set()
             await self.election.run(
@@ -284,7 +285,15 @@ class CapacityServer(CapacityServicer):
             from doorman_tpu.solver.batch import BatchSolver
 
             self._solver = BatchSolver(clock=self._clock)
+            self._push_groups()
         return self._solver
+
+    def _push_groups(self) -> None:
+        """Hand the config's capacity groups to the batch solver."""
+        if self._solver is not None and self.config is not None:
+            self._solver.set_groups(
+                {g.name: g.capacity for g in self.config.groups}
+            )
 
     async def tick_once(self) -> None:
         """Run one batched solve over all resources. Snapshot packing and
@@ -340,7 +349,8 @@ class CapacityServer(CapacityServicer):
                 has = req.has.capacity if req.HasField("has") else 0.0
                 lease, res = self._decide(
                     req.resource_id,
-                    Request(request.client_id, has, req.wants, 1),
+                    Request(request.client_id, has, req.wants, 1,
+                            priority=req.priority),
                 )
                 resp = out.response.add()
                 resp.resource_id = req.resource_id
@@ -368,11 +378,17 @@ class CapacityServer(CapacityServicer):
                 wants_total = sum(band.wants for band in req.wants)
                 subclients_total = sum(band.num_clients for band in req.wants)
                 has = req.has.capacity if req.HasField("has") else 0.0
+                # The aggregated request represents its highest band: a
+                # PRIORITY_BANDS parent serves servers carrying important
+                # clients first (band detail stays at the leaf).
+                priority = max(
+                    (band.priority for band in req.wants), default=0
+                )
                 lease, res = self._decide(
                     req.resource_id,
                     Request(
                         request.server_id, has, wants_total,
-                        max(subclients_total, 1),
+                        max(subclients_total, 1), priority=priority,
                     ),
                 )
                 resp = out.response.add()
@@ -426,6 +442,7 @@ class CapacityServer(CapacityServicer):
                 res.store.get(request.client).has,
                 request.wants,
                 request.subclients,
+                priority=request.priority,
             )
             return lease, res
         return res.decide(request), res

@@ -197,7 +197,7 @@ class SimServer:
 
         out = self._create_lease(res, gets)
         # (Re)assign with the clamped expiry so store cleanup follows the
-        # sim's lease rules.
+        # sim's lease rules; keep whatever priority the algorithm recorded.
         res.store.assign(
             client_id,
             out.expiry_time - now,
@@ -205,6 +205,7 @@ class SimServer:
             gets,
             wants,
             subclients,
+            priority=res.store.get(client_id).priority,
         )
         return out
 

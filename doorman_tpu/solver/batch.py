@@ -19,14 +19,43 @@ from typing import Callable, Dict, Iterable, List
 import jax
 import numpy as np
 
+from dataclasses import dataclass
+
+from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.core.snapshot import (
     ResourceSpec,
     Snapshot,
+    _bucket,
     pack_edge_arrays,
     pack_snapshot,
 )
 from doorman_tpu.solver.kernels import solve_tick_jit
+
+
+@dataclass
+class PrioritySnapshot:
+    """The PRIORITY_BANDS resources of one tick in the dense layout
+    (solver.priority); built by BatchSolver.snapshot, solved alongside
+    the lane snapshot, written back by BatchSolver.apply.
+
+    Two pack flavors (mirroring Snapshot): the Python-store pack carries
+    per-slot client names; the native pack carries the flat
+    ridx/cids/pos handle arrays plus the engine, and write-back is one
+    dm_apply call."""
+
+    resource_ids: List[str]
+    learning: List[bool]
+    batch: object  # solver.priority.PriorityBatch
+    num_bands: int
+    # Python-store pack:
+    clients: "List[List[str]] | None" = None  # per resource, per K slot
+    # Native pack:
+    engine: object = None
+    ridx: "np.ndarray | None" = None  # [E] segment per edge
+    cids: "np.ndarray | None" = None  # [E] client handles
+    pos: "np.ndarray | None" = None  # [E] slot within the resource row
+    gets: "np.ndarray | None" = None  # [R, K], filled by solve()
 
 
 def _shared_native_engine(stores) -> "object | None":
@@ -66,15 +95,31 @@ class BatchSolver:
         self._device = device
         self._clock = clock
         self._solve = solve_tick_jit
+        self._group_caps: Dict[str, float] = {}
         self.ticks = 0
         self.last_tick_seconds = 0.0
         self._tick_start = 0.0
+
+    def set_groups(self, group_caps: Dict[str, float]) -> None:
+        """Install the config's capacity groups (name -> shared cap);
+        referenced by PRIORITY_BANDS resources via
+        ResourceTemplate.capacity_group."""
+        self._group_caps = dict(group_caps)
 
     def _to_device(self, arr: np.ndarray):
         return jax.device_put(arr, self._device)
 
     def snapshot(self, resources: Iterable[Resource]) -> Snapshot:
-        res_list: List[Resource] = list(resources)
+        all_res: List[Resource] = list(resources)
+        # PRIORITY_BANDS resources solve in their own dense part; the
+        # solve_lanes kernels carry every other kind.
+        res_list, prio_res = [], []
+        for r in all_res:
+            is_prio = (
+                algo_kind_for(r.template) == AlgoKind.PRIORITY_BANDS
+            )
+            (prio_res if is_prio else res_list).append(r)
+        part = self._snapshot_priority(prio_res) if prio_res else None
         by_id: Dict[str, Resource] = {r.id: r for r in res_list}
         specs = [
             ResourceSpec(
@@ -92,8 +137,8 @@ class BatchSolver:
         stores = [r.store for r in res_list]
         engine = _shared_native_engine(stores) if stores else None
         if engine is not None:
-            ridx, cid, wants, has, sub = engine.pack(stores)
-            return pack_edge_arrays(
+            ridx, cid, wants, has, sub, _prio = engine.pack(stores)
+            snap = pack_edge_arrays(
                 specs,
                 ridx,
                 wants.astype(self._dtype, copy=False),
@@ -104,6 +149,8 @@ class BatchSolver:
                 engine=engine,
                 cids=cid,
             )
+            snap.priority_part = part
+            return snap
 
         def rows(resource_id: str):
             store = by_id[resource_id].store
@@ -112,8 +159,111 @@ class BatchSolver:
                 for client, lease in store.items()
             ]
 
-        return pack_snapshot(
+        snap = pack_snapshot(
             specs, rows, dtype=self._dtype, to_device=self._to_device
+        )
+        snap.priority_part = part
+        return snap
+
+    def _snapshot_priority(
+        self, prio_res: List[Resource]
+    ) -> PrioritySnapshot:
+        """Dense pack of the PRIORITY_BANDS resources: higher wire
+        priority = lower band rank; capacity groups resolved against the
+        config's group caps. Stores sharing a native engine pack via one
+        dm_pack call (no per-lease Python objects)."""
+        from doorman_tpu.solver.priority import PriorityBatch
+
+        R = len(prio_res)
+        dtype = self._dtype
+        capacity = np.zeros(R, dtype)
+        group = np.full(R, -1, np.int32)
+        learning: List[bool] = []
+        group_ids: Dict[str, int] = {}
+        group_caps: List[float] = []
+        for i, res in enumerate(prio_res):
+            capacity[i] = res.capacity
+            learning.append(res.in_learning_mode)
+            tpl = res.template
+            if tpl.HasField("capacity_group"):
+                name = tpl.capacity_group
+                if name in self._group_caps:
+                    if name not in group_ids:
+                        group_ids[name] = len(group_caps)
+                        group_caps.append(float(self._group_caps[name]))
+                    group[i] = group_ids[name]
+
+        stores = [r.store for r in prio_res]
+        engine = _shared_native_engine(stores)
+        num_bands = 1
+        clients: "List[List[str]] | None" = None
+        ridx = cids = pos = None
+        if engine is not None:
+            ridx, cids, wants_f, _has_f, sub_f, prio_f = engine.pack(stores)
+            counts = np.bincount(ridx, minlength=R)
+            K = _bucket(int(counts.max()) if len(ridx) else 1, 8)
+            starts = np.zeros(R + 1, np.int64)
+            np.cumsum(counts, out=starts[1:])
+            pos = np.arange(len(ridx), dtype=np.int64) - starts[ridx]
+            wants = np.zeros((R, K), dtype)
+            weights = np.zeros((R, K), dtype)
+            band = np.zeros((R, K), np.int32)
+            active = np.zeros((R, K), bool)
+            wants[ridx, pos] = wants_f
+            weights[ridx, pos] = sub_f
+            active[ridx, pos] = True
+            band_f = np.zeros(len(ridx), np.int32)
+            for i in range(R):
+                s, e = starts[i], starts[i + 1]
+                if s == e:
+                    continue
+                levels = np.unique(prio_f[s:e])[::-1]  # descending
+                num_bands = max(num_bands, len(levels))
+                band_f[s:e] = np.searchsorted(-levels, -prio_f[s:e])
+            band[ridx, pos] = band_f
+        else:
+            counts = [len(r.store) for r in prio_res]
+            K = _bucket(max(counts + [1]), 8)
+            wants = np.zeros((R, K), dtype)
+            weights = np.zeros((R, K), dtype)
+            band = np.zeros((R, K), np.int32)
+            active = np.zeros((R, K), bool)
+            clients = []
+            for i, res in enumerate(prio_res):
+                row = []
+                leases = list(res.store.items())[:K]
+                levels = sorted(
+                    {lease.priority for _, lease in leases}, reverse=True
+                )
+                rank = {p: j for j, p in enumerate(levels)}
+                num_bands = max(num_bands, len(levels))
+                for j, (client, lease) in enumerate(leases):
+                    row.append(client)
+                    wants[i, j] = lease.wants
+                    weights[i, j] = lease.subclients
+                    band[i, j] = rank[lease.priority]
+                    active[i, j] = True
+                clients.append(row)
+
+        batch = PriorityBatch(
+            wants=self._to_device(wants),
+            weights=self._to_device(weights),
+            band=self._to_device(band),
+            active=self._to_device(active),
+            capacity=self._to_device(capacity),
+            group=self._to_device(group),
+            group_cap=self._to_device(np.asarray(group_caps, dtype)),
+        )
+        return PrioritySnapshot(
+            resource_ids=[r.id for r in prio_res],
+            learning=learning,
+            batch=batch,
+            num_bands=_bucket(num_bands, 1),
+            clients=clients,
+            engine=engine,
+            ridx=ridx,
+            cids=cids,
+            pos=pos,
         )
 
     def prepare(self, resources: Iterable[Resource]) -> Snapshot:
@@ -128,9 +278,18 @@ class BatchSolver:
     def solve(self, snap: Snapshot) -> np.ndarray:
         """Phase 2 (device; blocking — safe to run in an executor thread,
         touches no host store state)."""
+        part = snap.priority_part
+        if part is not None:
+            from doorman_tpu.solver.priority import solve_priority
+
+            # Dispatch the priority part first so both solves overlap.
+            prio_gets = solve_priority(part.batch, num_bands=part.num_bands)
         # device_get, not np.asarray: on tunneled platforms (axon) asarray
         # takes a pathologically slow element-wise path.
-        return jax.device_get(self._solve(snap.edges, snap.resources))
+        gets = jax.device_get(self._solve(snap.edges, snap.resources))
+        if part is not None:
+            part.gets = jax.device_get(prio_gets)
+        return gets
 
     def apply(
         self,
@@ -170,12 +329,99 @@ class BatchSolver:
                     grant,
                     old.wants,
                     old.subclients,
+                    priority=old.priority,
                 )
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
+        self._apply_priority_part(by_id, snap, out, return_grants)
         self.ticks += 1
         self.last_tick_seconds = self._clock() - self._tick_start
         return out
+
+    def _apply_priority_part(
+        self,
+        by_id: Dict[str, Resource],
+        snap: Snapshot,
+        out: Dict[str, Dict[str, float]],
+        return_grants: bool,
+    ) -> None:
+        """Write the priority part's grants back (same skip/preserve rules
+        as the lane path; learning-mode resources replay reported has)."""
+        part = snap.priority_part
+        if part is None:
+            return
+        if part.engine is not None:
+            self._apply_priority_native(by_id, part, out, return_grants)
+            return
+        for i, resource_id in enumerate(part.resource_ids):
+            res = by_id.get(resource_id)
+            if res is None:
+                continue
+            algo = res.template.algorithm
+            for j, client_id in enumerate(part.clients[i]):
+                if not res.store.has_client(client_id):
+                    continue
+                old = res.store.get(client_id)
+                grant = (
+                    old.has if part.learning[i] else float(part.gets[i, j])
+                )
+                res.store.assign(
+                    client_id,
+                    float(algo.lease_length),
+                    float(algo.refresh_interval),
+                    grant,
+                    old.wants,
+                    old.subclients,
+                    priority=old.priority,
+                )
+                if return_grants:
+                    out.setdefault(resource_id, {})[client_id] = grant
+
+    def _apply_priority_native(
+        self,
+        by_id: Dict[str, Resource],
+        part: PrioritySnapshot,
+        out: Dict[str, Dict[str, float]],
+        return_grants: bool,
+    ) -> None:
+        """One dm_apply call writes the priority part back; learning-mode
+        segments refresh expiries but keep the reported has."""
+        engine = part.engine
+        now = self._clock()
+        n_seg = len(part.resource_ids)
+        order = np.full(n_seg, -1, np.int32)
+        expiry = np.zeros(n_seg, np.float64)
+        refresh = np.zeros(n_seg, np.float64)
+        keep_has = np.zeros(n_seg, np.uint8)
+        for i, resource_id in enumerate(part.resource_ids):
+            res = by_id.get(resource_id)
+            if res is None:
+                continue
+            if getattr(res.store, "_engine", None) is not engine:
+                continue
+            algo = res.template.algorithm
+            order[i] = res.store._rid
+            expiry[i] = now + float(algo.lease_length)
+            refresh[i] = float(algo.refresh_interval)
+            keep_has[i] = 1 if part.learning[i] else 0
+        flat = np.asarray(
+            part.gets[part.ridx, part.pos], np.float64
+        )
+        applied = engine.apply(
+            order, part.ridx, part.cids, flat, expiry, refresh, keep_has
+        )
+        if not return_grants:
+            return
+        name = engine.client_name
+        for i in np.nonzero(applied)[0]:
+            seg = int(part.ridx[i])
+            resource_id = part.resource_ids[seg]
+            client_id = name(int(part.cids[i]))
+            if keep_has[seg]:
+                grant = by_id[resource_id].store.get(client_id).has
+            else:
+                grant = float(flat[i])
+            out.setdefault(resource_id, {})[client_id] = grant
 
     def _apply_native(
         self,

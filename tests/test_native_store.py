@@ -91,7 +91,7 @@ def test_engine_pack_resource_major():
             expect.append((r, f"c{r}-{j}", float(10 * r + j), float(j),
                            float(1 + j)))
     assert engine.total_leases == 6
-    ridx, cid, wants, has, sub = engine.pack(stores)
+    ridx, cid, wants, has, sub, _prio = engine.pack(stores)
     got = [
         (int(ridx[i]), engine.client_name(int(cid[i])), wants[i], has[i],
          sub[i])
