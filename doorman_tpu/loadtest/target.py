@@ -111,6 +111,9 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="loadtest-target")
     p.add_argument("--port", type=int, default=16000)
     p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="serve /metrics (and /debug pages) on this port; "
+                        "-1 disables")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -118,6 +121,11 @@ def main(argv=None) -> None:
         target = Target()
         port = await target.start(args.port, args.host)
         log.info("target listening on %s:%d", args.host, port)
+        if args.metrics_port >= 0:
+            from doorman_tpu.obs.debug import DebugServer
+
+            debug = DebugServer(host="", port=args.metrics_port)
+            log.info("metrics on port %d", debug.start())
         await asyncio.Event().wait()
 
     try:
