@@ -12,6 +12,8 @@ A second mesh axis ("dc") models the two-level tree.
 
 from doorman_tpu.parallel.mesh import make_mesh  # noqa: F401
 from doorman_tpu.parallel.sharded import (  # noqa: F401
+    make_sharded_dense_solver,
     make_sharded_solver,
+    shard_dense,
     shard_edges,
 )

@@ -92,6 +92,95 @@ def make_sharded_solver(mesh: Mesh, *, donate: bool = False):
     return solve
 
 
+def make_sharded_dense_solver(mesh: Mesh, *, donate: bool = False):
+    """Resource-axis sharded dense solve: the [R, K] bucket tables shard
+    their row axis across every mesh axis. Rows are independent (each row
+    is one resource's clients), so unlike the edge path this needs NO
+    collectives — pure scale-out of the TPU-optimal layout; grants land
+    sharded the same way. Place inputs with `shard_dense` (which also
+    pads R up to the device count).
+
+    With donate=True the four per-tick [R, K] demand tables are donated;
+    the per-resource config arrays are reused across ticks."""
+    from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+    axes = tuple(mesh.axis_names)
+    row = P(axes)
+    rowk = P(axes, None)
+
+    def shard_fn(wants, has, sub, active, cap, kind, learning, static_cap):
+        return solve_dense(
+            DenseBatch(
+                wants=wants, has=has, subclients=sub, active=active,
+                capacity=cap, algo_kind=kind, learning=learning,
+                static_capacity=static_cap,
+            )
+        )
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(rowk, rowk, rowk, rowk, row, row, row, row),
+        out_specs=rowk,
+    )
+
+    @partial(jax.jit, donate_argnums=tuple(range(4)) if donate else ())
+    def solve_parts(
+        wants, has, subclients, active, capacity, algo_kind, learning,
+        static_capacity,
+    ) -> jax.Array:
+        return mapped(
+            wants, has, subclients, active,
+            capacity, algo_kind, learning, static_capacity,
+        )
+
+    def solve(batch) -> jax.Array:
+        return solve_parts(
+            batch.wants, batch.has, batch.subclients, batch.active,
+            batch.capacity, batch.algo_kind, batch.learning,
+            batch.static_capacity,
+        )
+
+    return solve
+
+
+def shard_dense(mesh: Mesh, batch):
+    """Place a DenseBatch on the mesh: row (resource) axis sharded over
+    all mesh axes, padded with inactive rows up to a multiple of the
+    device count (the dense analog of shard_edges)."""
+    from doorman_tpu.solver.dense import DenseBatch
+
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    R = int(np.asarray(batch.capacity).shape[0])
+    pad = (-R) % n_dev
+
+    def rows(arr):
+        arr = np.asarray(arr)
+        if pad:
+            arr = np.concatenate(
+                [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)]
+            )
+        return arr
+
+    axes = tuple(mesh.axis_names)
+    put_rowk = lambda a: jax.device_put(
+        rows(a), NamedSharding(mesh, P(axes, None))
+    )
+    put_row = lambda a: jax.device_put(
+        rows(a), NamedSharding(mesh, P(axes))
+    )
+    return DenseBatch(
+        wants=put_rowk(batch.wants),
+        has=put_rowk(batch.has),
+        subclients=put_rowk(batch.subclients),
+        active=put_rowk(batch.active),
+        capacity=put_row(batch.capacity),
+        algo_kind=put_row(batch.algo_kind),
+        learning=put_row(batch.learning),
+        static_capacity=put_row(batch.static_capacity),
+    )
+
+
 def shard_edges(mesh: Mesh, edges: EdgeBatch) -> EdgeBatch:
     """Place an EdgeBatch on the mesh: edge arrays sharded over all mesh
     axes. The edge axis is padded (inactive edges) up to a multiple of the

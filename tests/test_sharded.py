@@ -84,3 +84,34 @@ def test_dc_aggregates_match_global_sums():
             np.asarray(s).sum(axis=0)[r],
             np.asarray(edges.subclients)[mask].sum(),
         )
+
+
+def test_sharded_dense_matches_single_chip():
+    """Resource-axis sharded dense solve (no collectives) must equal the
+    unsharded dense solve; R=23 exercises shard_dense's row padding."""
+    from doorman_tpu.parallel import make_sharded_dense_solver, shard_dense
+    from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+    rng = np.random.default_rng(3)
+    R, K, C = 23, 128, 100  # pads to 24 rows over 8 devices
+    active = np.zeros((R, K), bool)
+    active[:, :C] = True
+    mesh = make_mesh([8], ("clients",), jax.devices()[:8])
+    host = DenseBatch(
+        wants=(rng.integers(0, 100, (R, K)) * active).astype(np.float64),
+        has=(rng.integers(0, 50, (R, K)) * active).astype(np.float64),
+        subclients=active.astype(np.float64),
+        active=active,
+        capacity=rng.integers(100, 10_000, R).astype(np.float64),
+        algo_kind=rng.integers(0, 5, R).astype(np.int32),
+        learning=rng.random(R) < 0.2,
+        static_capacity=rng.integers(1, 100, R).astype(np.float64),
+    )
+    batch = shard_dense(mesh, host)
+    solver = make_sharded_dense_solver(mesh, donate=True)
+    got = np.asarray(solver(batch))
+    batch2 = shard_dense(mesh, host)  # donated buffers are consumed
+    expected = np.asarray(jax.jit(solve_dense)(batch2))
+    np.testing.assert_allclose(got[:R], expected[:R], rtol=1e-12,
+                               atol=1e-12)
+    assert (got[R:] == 0).all()  # padded rows are inactive
