@@ -282,8 +282,13 @@ class BatchSolver:
         if part is not None:
             from doorman_tpu.solver.priority import solve_priority
 
-            # Dispatch the priority part first so both solves overlap.
-            prio_gets = solve_priority(part.batch, num_bands=part.num_bands)
+            # Dispatch the priority part first so both solves overlap;
+            # on TPU the banded water-fill runs as the fused VMEM kernel.
+            prio_gets = solve_priority(
+                part.batch,
+                num_bands=part.num_bands,
+                use_pallas=jax.default_backend() == "tpu",
+            )
         # device_get, not np.asarray: on tunneled platforms (axon) asarray
         # takes a pathologically slow element-wise path.
         gets = jax.device_get(self._solve(snap.edges, snap.resources))

@@ -1,0 +1,55 @@
+"""Shared scaffolding for the pallas row-tile kernels (pallas_dense,
+pallas_priority): tile sizing under a VMEM budget, padding, and block
+specs. One place to tune; both kernels stay in lockstep."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+# Conservative budget for the live [T, K] intermediates a lane/water-fill
+# body keeps in VMEM (~8 of them out of the ~16MB per core).
+_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+_LIVE_TILES = 8
+_MAX_TILE_R = 1024
+
+
+def tile_rows(R: int, K: int, itemsize: int) -> int:
+    """Rows per grid step: as large as the VMEM budget allows (big tiles
+    amortize per-op overhead in the iterative water-fill), capped so a
+    small table is not padded up to a huge tile."""
+    per_row = max(K, LANE) * itemsize * _LIVE_TILES
+    tile = max(8, min(_MAX_TILE_R, _VMEM_BUDGET_BYTES // per_row))
+    tile -= tile % 8
+    rows_needed = R + (-R) % 8
+    return max(8, min(tile, rows_needed))
+
+
+def pad_tile(x: jax.Array, rpad: int, kpad: int) -> jax.Array:
+    """Pad an [R, K] array to tile boundaries (values 0 / False)."""
+    if rpad or kpad:
+        x = jnp.pad(x, ((0, rpad), (0, kpad)))
+    return x
+
+
+def pad_col(x: jax.Array, rpad: int) -> jax.Array:
+    """[R] -> [R + rpad, 1] column."""
+    x = x[:, None]
+    if rpad:
+        x = jnp.pad(x, ((0, rpad), (0, 0)))
+    return x
+
+
+def row_spec(tile_r: int, Kp: int) -> pl.BlockSpec:
+    return pl.BlockSpec(
+        (tile_r, Kp), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+
+
+def col_spec(tile_r: int) -> pl.BlockSpec:
+    return pl.BlockSpec(
+        (tile_r, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )

@@ -24,13 +24,17 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from doorman_tpu.solver.dense import DenseBatch
 from doorman_tpu.solver.lanes import solve_lanes
-
-TILE_R = 256
-LANE = 128
+from doorman_tpu.solver.pallas_common import (
+    LANE,
+    col_spec,
+    pad_col,
+    pad_tile,
+    row_spec,
+    tile_rows,
+)
 
 
 def _kernel(wants_ref, has_ref, sub_ref, active_ref, cap_ref, kind_ref,
@@ -59,44 +63,34 @@ def solve_dense_pallas(batch: DenseBatch, interpret: bool = False) -> jax.Array:
     """
     R, K = batch.wants.shape
     dtype = batch.wants.dtype
-    rpad = (-R) % TILE_R
     kpad = (-K) % LANE
+    Kp = K + kpad
+    tile_r = tile_rows(R, Kp, jnp.dtype(dtype).itemsize)
+    rpad = (-R) % tile_r
+    Rp = R + rpad
 
     def tile(x):  # [R, K] compute-dtype, padded
-        x = x.astype(dtype)
-        if rpad or kpad:
-            x = jnp.pad(x, ((0, rpad), (0, kpad)))
-        return x
+        return pad_tile(x.astype(dtype), rpad, kpad)
 
-    def col(x, cdtype):  # [R] -> [Rpad, 1]
-        x = x.astype(cdtype)[:, None]
-        if rpad:
-            x = jnp.pad(x, ((0, rpad), (0, 0)))
-        return x
+    def col(x, cdtype):  # [R] -> [Rp, 1]
+        return pad_col(x.astype(cdtype), rpad)
 
-    Rp, Kp = R + rpad, K + kpad
-    grid = (Rp // TILE_R,)
-    row_spec = pl.BlockSpec(
-        (TILE_R, Kp), lambda i: (i, 0), memory_space=pltpu.VMEM
-    )
-    col_spec = pl.BlockSpec(
-        (TILE_R, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
-    )
+    rows, cols = row_spec(tile_r, Kp), col_spec(tile_r)
     gets = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((Rp, Kp), dtype),
-        grid=grid,
+        grid=(Rp // tile_r,),
         in_specs=[
-            row_spec,  # wants
-            row_spec,  # has
-            row_spec,  # subclients
-            row_spec,  # active mask
-            col_spec,  # capacity
-            col_spec,  # algo_kind
-            col_spec,  # learning mask
-            col_spec,  # static_capacity
+            rows,  # wants
+            rows,  # has
+            rows,  # subclients
+            rows,  # active mask
+            cols,  # capacity
+            cols,  # algo_kind
+            cols,  # learning mask
+            cols,  # static_capacity
         ],
-        out_specs=row_spec,
+        out_specs=rows,
         interpret=interpret,
     )(
         tile(batch.wants),

@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the banded water-fill (solver.priority).
+
+The group-cap bisection in solve_priority evaluates the banded
+allocation ~THETA_ITERS times; under plain XLA every evaluation streams
+the [R, K] demand tiles from HBM once per water-fill iteration per band
+(~200 passes each). This kernel runs one full banded allocation per row
+tile entirely in VMEM — bands unrolled statically, each band's bisection
+on-chip — so a theta evaluation costs ONE read and one write of the
+tiles. Semantics identical to priority._alloc_banded; parity pinned in
+tests/test_pallas_priority.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from doorman_tpu.solver.lanes import waterfill_level
+from doorman_tpu.solver.pallas_common import (
+    LANE,
+    col_spec,
+    pad_col,
+    pad_tile,
+    row_spec,
+    tile_rows,
+)
+
+
+def _make_kernel(num_bands: int):
+    def kernel(wants_ref, weights_ref, band_ref, active_ref, cap_ref,
+               out_ref):
+        wants = wants_ref[:]
+        weights = weights_ref[:]
+        band = band_ref[:]
+        active = active_ref[:] > 0
+        zero = jnp.zeros((), wants.dtype)
+        segsum = lambda v: jnp.sum(v, axis=1, keepdims=True)
+        segmax = lambda v: jnp.max(v, axis=1, keepdims=True)
+        expand = lambda t: t
+
+        remaining = cap_ref[:]  # [T, 1]
+        gets = jnp.zeros_like(wants)
+        for rank in range(num_bands):  # static unroll, VMEM-resident
+            m = active & (band == rank)
+            w = jnp.where(m, wants, zero)
+            wt = jnp.where(m, weights, zero)
+            level = waterfill_level(
+                w, wt, m, remaining, segsum, segmax, expand
+            )
+            fits = segsum(w) <= remaining
+            share = jnp.where(fits, w, jnp.minimum(w, level * wt))
+            share = jnp.where(m, share, zero)
+            gets = gets + share
+            remaining = jnp.maximum(remaining - segsum(share), 0.0)
+        out_ref[:] = gets
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bands", "interpret")
+)
+def alloc_banded_pallas(
+    wants: jax.Array,  # [R, K]
+    weights: jax.Array,  # [R, K]
+    band: jax.Array,  # [R, K] int32
+    active: jax.Array,  # [R, K] bool
+    capacity: jax.Array,  # [R]
+    num_bands: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Grants [R, K]; bit-compatible with priority._alloc_banded."""
+    R, K = wants.shape
+    dtype = wants.dtype
+    kpad = (-K) % LANE
+    Kp = K + kpad
+    tile_r = tile_rows(R, Kp, jnp.dtype(dtype).itemsize)
+    rpad = (-R) % tile_r
+    Rp = R + rpad
+
+    def tile(x):
+        x = x.astype(dtype) if x.dtype != jnp.int32 else x
+        return pad_tile(x, rpad, kpad)
+
+    rows, cols = row_spec(tile_r, Kp), col_spec(tile_r)
+    gets = pl.pallas_call(
+        _make_kernel(num_bands),
+        out_shape=jax.ShapeDtypeStruct((Rp, Kp), dtype),
+        grid=(Rp // tile_r,),
+        in_specs=[
+            rows,  # wants
+            rows,  # weights
+            rows,  # band (int32)
+            rows,  # active mask (compute dtype)
+            cols,  # capacity
+        ],
+        out_specs=rows,
+        interpret=interpret,
+    )(
+        tile(wants),
+        tile(weights),
+        tile(band.astype(jnp.int32)),
+        tile(active.astype(dtype)),
+        pad_col(capacity.astype(dtype), rpad),
+    )
+    return gets[:R, :K]

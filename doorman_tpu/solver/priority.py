@@ -26,7 +26,21 @@ import jax.numpy as jnp
 
 from doorman_tpu.solver.lanes import waterfill_level
 
-THETA_ITERS = 64  # matches algorithms.priority.THETA_ITERS
+THETA_ITERS = 64  # matches algorithms.priority.THETA_ITERS (f64)
+
+
+def _theta_iters(dtype) -> int:
+    """f64 runs the oracle's full 64 plain-bisection iterations for
+    strict parity. f32 runs 32 and then recovers RELATIVE precision for
+    tiny theta (a heavily over-capped group has theta* below the 2^-32
+    absolute bisection granularity) with the multiplicative refinement
+    below — usage is ~linear in theta there, so one proportional step
+    lands on theta* to f32 precision."""
+    return THETA_ITERS if jnp.dtype(dtype).itemsize >= 8 else 32
+
+
+def _theta_refine_steps(dtype) -> int:
+    return 0 if jnp.dtype(dtype).itemsize >= 8 else 2
 
 
 @jax.tree_util.register_dataclass
@@ -77,32 +91,47 @@ def _alloc_banded(
     return gets
 
 
-@functools.partial(jax.jit, static_argnames=("num_bands",))
-def solve_priority(batch: PriorityBatch, num_bands: int = 4) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("num_bands", "use_pallas"))
+def solve_priority(
+    batch: PriorityBatch, num_bands: int = 4, use_pallas: bool = False
+) -> jax.Array:
     """Grants [R, K]; matches algorithms.priority.grouped_priority_alloc.
 
     `num_bands` bounds the band loop (host packs dense ranks < num_bands;
-    edges with band >= num_bands are never served)."""
+    edges with band >= num_bands are never served). `use_pallas` runs the
+    banded water-fill as the fused VMEM kernel (TPU only) — the group-cap
+    bisection evaluates it ~THETA_ITERS times, so the fusion's
+    one-HBM-pass-per-evaluation matters."""
     dtype = batch.wants.dtype
     wants = jnp.where(batch.active, batch.wants, 0.0).astype(dtype)
     weights = jnp.where(batch.active, batch.weights, 0.0).astype(dtype)
+
+    if use_pallas:
+        from doorman_tpu.solver.pallas_priority import alloc_banded_pallas
+
+        def alloc(eff_cap):
+            return alloc_banded_pallas(
+                wants, weights, batch.band, batch.active, eff_cap,
+                num_bands,
+            )
+    else:
+        def alloc(eff_cap):
+            return _alloc_banded(
+                wants, weights, batch.band, batch.active, eff_cap,
+                num_bands,
+            )
+
     G = batch.group_cap.shape[0]
     if G == 0:
         # No cross-resource caps configured: a single banded pass.
-        return _alloc_banded(
-            wants, weights, batch.band, batch.active, batch.capacity,
-            num_bands,
-        )
+        return alloc(batch.capacity)
     grouped = batch.group >= 0
     # Gather index clamped for uncoupled resources (group id -1).
     gidx = jnp.where(grouped, batch.group, 0)
 
     def usage_per_group(theta_g):  # [G] -> [G]
         theta_r = jnp.where(grouped, theta_g[gidx], 1.0)
-        gets = _alloc_banded(
-            wants, weights, batch.band, batch.active,
-            batch.capacity * theta_r, num_bands,
-        )
+        gets = alloc(batch.capacity * theta_r)
         per_resource = gets.sum(axis=1)
         return jax.ops.segment_sum(
             jnp.where(grouped, per_resource, 0.0), gidx, num_segments=G
@@ -119,10 +148,20 @@ def solve_priority(batch: PriorityBatch, num_bands: int = 4) -> jax.Array:
     # theta = 1 feasible => skip straight to 1 (matches the oracle's
     # early-out, which never bisects a group that already fits).
     fits_at_one = usage_per_group(hi) <= batch.group_cap
-    lo, hi = jax.lax.fori_loop(0, THETA_ITERS, body, (lo, hi))
+    lo, hi = jax.lax.fori_loop(0, _theta_iters(dtype), body, (lo, hi))
+    for _ in range(_theta_refine_steps(dtype)):
+        # Proportional (relative-precision) refinement: scale the feasible
+        # lo toward the cap; keep the candidate only if still feasible.
+        u = usage_per_group(lo)
+        cand = jnp.where(
+            u > 0,
+            lo * batch.group_cap
+            / jnp.maximum(u, jnp.finfo(dtype).tiny),
+            lo,
+        )
+        cand = jnp.clip(cand, lo, hi)
+        feasible = usage_per_group(cand) <= batch.group_cap
+        lo = jnp.where(feasible, cand, lo)
     theta_g = jnp.where(fits_at_one, 1.0, lo)
     theta_r = jnp.where(grouped, theta_g[gidx], 1.0)
-    return _alloc_banded(
-        wants, weights, batch.band, batch.active,
-        batch.capacity * theta_r, num_bands,
-    )
+    return alloc(batch.capacity * theta_r)

@@ -130,6 +130,24 @@ def test_no_groups_configured():
         )
 
 
+def test_heavily_overcapped_group_f32():
+    """theta* far below the f32 bisection's absolute granularity: the
+    multiplicative refinement must keep the group at its cap to f32
+    relative precision (regression for the 32-iteration f32 path)."""
+    wants = np.full((1, 4), 2.5e5, np.float32)
+    batch = PriorityBatch(
+        wants=jnp.asarray(wants),
+        weights=jnp.ones((1, 4), jnp.float32),
+        band=jnp.zeros((1, 4), jnp.int32),
+        active=jnp.ones((1, 4), bool),
+        capacity=jnp.asarray([1e6], jnp.float32),
+        group=jnp.asarray([0], jnp.int32),
+        group_cap=jnp.asarray([1e-2], jnp.float32),
+    )
+    got = np.asarray(solve_priority(batch, num_bands=1))
+    assert got.sum() == pytest.approx(1e-2, rel=1e-4)
+
+
 # ---------------------------------------------------------------- parity
 
 def _random_case(rng, R=12, K=32, G=3, num_bands=4):
