@@ -61,6 +61,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "batched device solve")
     p.add_argument("--tick-interval", type=float, default=1.0,
                    help="batch mode: seconds between device solves")
+    p.add_argument("--solver-dtype", choices=("f32", "f64"), default="f64",
+                   help="batch solve precision: f64 matches the oracle "
+                        "bit-for-bit; f32 is TPU-native and enables the "
+                        "fused pallas kernels")
+    p.add_argument("--profile-dir", default="",
+                   help="batch mode: write a JAX profiler trace of the "
+                        "first --profile-ticks ticks to this directory")
+    p.add_argument("--profile-ticks", type=int, default=8)
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -105,6 +113,9 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         tick_interval=args.tick_interval,
         minimum_refresh_interval=args.minimum_refresh_interval,
         native_store=args.native_store,
+        profile_dir=args.profile_dir or None,
+        profile_ticks=args.profile_ticks,
+        solver_dtype=args.solver_dtype,
     )
 
     port = await server.start(

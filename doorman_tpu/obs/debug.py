@@ -152,10 +152,42 @@ class DebugServer:
             + "".join(sections)
             + "".join(status_parts())
             + "<p><a href='/debug/resources'>resources</a> | "
+            "<a href='/debug/requests'>requests</a> | "
             "<a href='/metrics'>metrics</a> | "
             "<a href='/debug/vars'>vars</a></p>"
         )
         return _PAGE.format(title="/debug/status", body=body)
+
+    def _requests_page(self, limit: int) -> str:
+        """Recent-RPC samples per server (the reference exposes gRPC's
+        /debug/requests sampling on its debug port,
+        doc/loadtest/README.md:322-324)."""
+        sections = []
+        for server, _loop in self._servers:
+            log_ = getattr(server, "request_log", None)
+            if log_ is None:
+                continue
+            rows = "".join(
+                f"<tr><td>{_fmt_ts(s.when)}</td>"
+                f"<td>{html.escape(s.method)}</td>"
+                f"<td>{html.escape(s.caller)}</td>"
+                f"<td>{html.escape(', '.join(s.resources))}</td>"
+                f"<td>{s.wants:g}</td>"
+                f"<td>{s.duration * 1000:.2f}</td>"
+                f"<td>{'ERROR' if s.error else 'ok'}</td></tr>"
+                for s in log_.snapshot(limit)
+            )
+            sections.append(
+                f"<h2>{html.escape(server.id)}</h2>"
+                f"<table><tr><th>when</th><th>method</th><th>caller</th>"
+                f"<th>resources</th><th>wants</th><th>ms</th>"
+                f"<th>outcome</th></tr>{rows}</table>"
+            )
+        if not sections:
+            sections.append("<p>no request samples</p>")
+        return _PAGE.format(
+            title="/debug/requests", body="".join(sections)
+        )
 
     def _resources_page(self, only: Optional[str]) -> str:
         sections = []
@@ -224,6 +256,16 @@ class DebugServer:
                         only = q.get("resource", [None])[0]
                         body, ctype = (
                             debug._resources_page(only),
+                            "text/html",
+                        )
+                    elif url.path == "/debug/requests":
+                        q = parse_qs(url.query)
+                        try:
+                            limit = max(0, int(q.get("limit", ["100"])[0]))
+                        except ValueError:
+                            limit = 100
+                        body, ctype = (
+                            debug._requests_page(limit),
                             "text/html",
                         )
                     elif url.path == "/debug/vars":

@@ -74,6 +74,9 @@ class CapacityServer(CapacityServicer):
         minimum_refresh_interval: float = 5.0,
         clock: Callable[[], float] = time.time,
         native_store: bool = False,
+        profile_dir: Optional[str] = None,
+        profile_ticks: int = 8,
+        solver_dtype: str = "f64",
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -119,6 +122,22 @@ class CapacityServer(CapacityServicer):
 
         # Metrics hooks; the metrics module replaces these when enabled.
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
+        # Always-on request sampling for /debug/requests.
+        from doorman_tpu.obs.requests import RequestLog
+
+        self.request_log = RequestLog()
+        # JAX profiler capture of the first batch ticks (SURVEY §5: "add
+        # JAX profiler traces around the solve"); view with xprof or
+        # tensorboard.
+        self.profile_dir = profile_dir
+        self.profile_ticks = profile_ticks
+        self._profiling = False
+        self._profile_done = False
+        if solver_dtype not in ("f32", "f64"):
+            raise ValueError(f"unknown solver dtype {solver_dtype!r}")
+        # f64 is the oracle-parity default; f32 trades exact parity for
+        # TPU-native arithmetic (and enables the fused pallas kernels).
+        self.solver_dtype = solver_dtype
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -164,6 +183,7 @@ class CapacityServer(CapacityServicer):
         return self.port
 
     async def stop(self) -> None:
+        self._stop_profiler()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -275,8 +295,9 @@ class CapacityServer(CapacityServicer):
     def _get_solver(self):
         if self._solver is None:
             import jax
+            import numpy as np
 
-            if not jax.config.jax_enable_x64:
+            if self.solver_dtype == "f64" and not jax.config.jax_enable_x64:
                 # The batch solver's f64 parity contract needs x64; the
                 # server owns the process, so enabling it here is safe.
                 log.info("%s: enabling jax_enable_x64 for the batch solver",
@@ -284,7 +305,8 @@ class CapacityServer(CapacityServicer):
                 jax.config.update("jax_enable_x64", True)
             from doorman_tpu.solver.batch import BatchSolver
 
-            self._solver = BatchSolver(clock=self._clock)
+            dtype = np.float64 if self.solver_dtype == "f64" else np.float32
+            self._solver = BatchSolver(clock=self._clock, dtype=dtype)
             self._push_groups()
         return self._solver
 
@@ -302,11 +324,39 @@ class CapacityServer(CapacityServicer):
         if not self.resources:
             return
         solver = self._get_solver()
+        if self.profile_dir and not self._profiling and not self._profile_done:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                self._profiling = True
+            except Exception:
+                # E.g. another trace already active in this process; the
+                # capture is best-effort and must never block solving.
+                log.exception("%s: profiler capture unavailable", self.id)
+                self._profile_done = True
         resources = list(self.resources.values())
         snap = solver.prepare(resources)
         loop = asyncio.get_running_loop()
         gets = await loop.run_in_executor(None, solver.solve, snap)
         solver.apply(resources, snap, gets, return_grants=False)
+        if self._profiling and solver.ticks >= self.profile_ticks:
+            self._stop_profiler()
+            log.info(
+                "%s: wrote a JAX profiler trace of %d ticks to %s",
+                self.id, solver.ticks, self.profile_dir,
+            )
+
+    def _stop_profiler(self) -> None:
+        """Finish the one-shot profiler capture (also on shutdown, so a
+        server stopped mid-window still flushes its trace)."""
+        if not self._profiling:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._profiling = False
+        self._profile_done = True
 
     async def _tick_loop(self) -> None:
         while True:
@@ -361,6 +411,12 @@ class CapacityServer(CapacityServicer):
             return out
         finally:
             self.on_request("GetCapacity", self._clock() - start, err)
+            self.request_log.record(
+                "GetCapacity", request.client_id,
+                [r.resource_id for r in request.resource],
+                sum(r.wants for r in request.resource),
+                self._clock() - start, err,
+            )
 
     async def GetServerCapacity(self, request, context):
         start = self._clock()
@@ -405,6 +461,15 @@ class CapacityServer(CapacityServicer):
             return out
         finally:
             self.on_request("GetServerCapacity", self._clock() - start, err)
+            self.request_log.record(
+                "GetServerCapacity", request.server_id,
+                [r.resource_id for r in request.resource],
+                sum(
+                    band.wants for r in request.resource
+                    for band in r.wants
+                ),
+                self._clock() - start, err,
+            )
 
     async def ReleaseCapacity(self, request, context):
         start = self._clock()
@@ -420,6 +485,11 @@ class CapacityServer(CapacityServicer):
             return out
         finally:
             self.on_request("ReleaseCapacity", self._clock() - start, False)
+            self.request_log.record(
+                "ReleaseCapacity", request.client_id,
+                list(request.resource_id), 0.0,
+                self._clock() - start, False,
+            )
 
     def _decide(self, resource_id: str, request: Request):
         """Produce a lease for one resource request. Immediate mode (and

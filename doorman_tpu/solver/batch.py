@@ -17,6 +17,7 @@ import time
 from typing import Callable, Dict, Iterable, List
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dataclasses import dataclass
@@ -283,11 +284,14 @@ class BatchSolver:
             from doorman_tpu.solver.priority import solve_priority
 
             # Dispatch the priority part first so both solves overlap;
-            # on TPU the banded water-fill runs as the fused VMEM kernel.
+            # on TPU the banded water-fill runs as the fused VMEM kernel
+            # (f32 only — Mosaic does not lower f64).
+            use_pallas = (
+                jax.default_backend() == "tpu"
+                and part.batch.wants.dtype == jnp.float32
+            )
             prio_gets = solve_priority(
-                part.batch,
-                num_bands=part.num_bands,
-                use_pallas=jax.default_backend() == "tpu",
+                part.batch, num_bands=part.num_bands, use_pallas=use_pallas
             )
         # device_get, not np.asarray: on tunneled platforms (axon) asarray
         # takes a pathologically slow element-wise path.

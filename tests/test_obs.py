@@ -132,6 +132,19 @@ def test_instrumented_server_and_debug_pages():
         assert status == 200
         assert "client-1" in page
 
+        # The request sample ring renders the RPC we just made.
+        status, page = await loop.run_in_executor(
+            None, fetch, dport, "/debug/requests"
+        )
+        assert status == 200
+        assert "GetCapacity" in page
+        assert "client-1" in page
+        assert "r0" in page
+        sample = server.request_log.snapshot(1)[0]
+        assert sample.method == "GetCapacity"
+        assert sample.wants == 40.0
+        assert not sample.error
+
         status, _ = await loop.run_in_executor(None, fetch, dport, "/healthz")
         assert status == 200
 
@@ -140,3 +153,33 @@ def test_instrumented_server_and_debug_pages():
         await server.stop()
 
     asyncio.run(body())
+
+
+def test_batch_tick_profiler_trace(tmp_path):
+    """--profile-dir writes a JAX profiler trace of the first ticks."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    async def body():
+        server = CapacityServer(
+            "prof-server", TrivialElection(), minimum_refresh_interval=0.0,
+            mode="batch", profile_dir=str(tmp_path), profile_ticks=1,
+        )
+        await server.load_config(parse_yaml_config(CONFIG))
+        await asyncio.sleep(0)
+        from doorman_tpu.proto import doorman_pb2 as pb
+
+        req = pb.GetCapacityRequest()
+        req.client_id = "c1"
+        r = req.resource.add()
+        r.resource_id = "r0"
+        r.wants = 10.0
+        await server.GetCapacity(req, None)
+        await server.tick_once()
+        await server.tick_once()
+        assert not server._profiling
+
+    asyncio.run(body())
+    traces = list(tmp_path.rglob("*"))
+    assert any(p.is_file() for p in traces), "no profiler trace written"
