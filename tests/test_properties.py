@@ -1,0 +1,144 @@
+"""Property-based invariants of the allocation solvers (SURVEY §4: keep
+the exact-grant tables as the oracle AND add property tests).
+
+Invariants, for every algorithm lane and random demand table:
+  * feasibility: sum(gets) <= capacity (except NO_ALGORITHM/learning,
+    which grant wants/has by design);
+  * no over-grant: gets <= wants (except learning: gets == has);
+  * fair-share floor: a client wanting at least its weighted equal share
+    receives at least that share when the resource is overloaded;
+  * monotone group caps: tightening a group cap never increases usage.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.solver.dense import DenseBatch, solve_dense
+from doorman_tpu.solver.priority import PriorityBatch, solve_priority
+
+FEASIBLE_KINDS = (
+    AlgoKind.PROPORTIONAL_SHARE,
+    AlgoKind.FAIR_SHARE,
+    AlgoKind.PROPORTIONAL_TOPUP,
+)
+
+
+@st.composite
+def demand_tables(draw, max_clients=24):
+    n = draw(st.integers(1, max_clients))
+    wants = draw(
+        st.lists(
+            st.floats(0, 1000, allow_nan=False), min_size=n, max_size=n
+        )
+    )
+    has = draw(
+        st.lists(st.floats(0, 500, allow_nan=False), min_size=n, max_size=n)
+    )
+    sub = draw(st.lists(st.integers(1, 5), min_size=n, max_size=n))
+    capacity = draw(st.floats(1, 5000, allow_nan=False))
+    return wants, has, sub, capacity
+
+
+def dense_batch(wants, has, sub, capacity, kind, learning=False):
+    n = len(wants)
+    K = 32
+    pad = lambda xs: np.pad(np.asarray(xs, np.float64), (0, K - n))
+    active = np.zeros(K, bool)
+    active[:n] = True
+    return DenseBatch(
+        wants=jnp.asarray(pad(wants))[None, :],
+        has=jnp.asarray(pad(has))[None, :],
+        subclients=jnp.asarray(pad(sub))[None, :],
+        active=jnp.asarray(active)[None, :],
+        capacity=jnp.asarray([capacity], jnp.float64),
+        algo_kind=jnp.asarray([int(kind)], jnp.int32),
+        learning=jnp.asarray([learning]),
+        static_capacity=jnp.asarray([7.0], jnp.float64),
+    )
+
+
+@settings(max_examples=60, deadline=None)
+@given(demand_tables(), st.sampled_from(FEASIBLE_KINDS))
+def test_feasibility_and_no_overgrant(table, kind):
+    wants, has, sub, capacity = table
+    gets = np.asarray(
+        solve_dense(dense_batch(wants, has, sub, capacity, kind))
+    )[0]
+    n = len(wants)
+    assert gets[: n].sum() <= capacity * (1 + 1e-9) + 1e-6
+    assert (gets[:n] <= np.asarray(wants) + 1e-9).all()
+    assert (gets[:n] >= -1e-12).all()
+    assert (gets[n:] == 0).all()
+
+
+@settings(max_examples=40, deadline=None)
+@given(demand_tables())
+def test_learning_replays_has(table):
+    wants, has, sub, capacity = table
+    gets = np.asarray(
+        solve_dense(
+            dense_batch(
+                wants, has, sub, capacity,
+                AlgoKind.PROPORTIONAL_SHARE, learning=True,
+            )
+        )
+    )[0]
+    n = len(wants)
+    np.testing.assert_allclose(gets[:n], np.asarray(has), rtol=1e-12)
+
+
+@settings(max_examples=40, deadline=None)
+@given(demand_tables())
+def test_fair_share_floor(table):
+    """In overload, a client wanting >= its weighted equal share gets at
+    least that share (max-min fairness floor)."""
+    wants, has, sub, capacity = table
+    wants_arr = np.asarray(wants)
+    sub_arr = np.asarray(sub, np.float64)
+    if wants_arr.sum() <= capacity:
+        return  # underloaded: everyone gets wants; floor is trivial
+    gets = np.asarray(
+        solve_dense(
+            dense_batch(wants, has, sub, capacity, AlgoKind.FAIR_SHARE)
+        )
+    )[0][: len(wants)]
+    equal = capacity / sub_arr.sum() * sub_arr
+    demanding = wants_arr >= equal
+    assert (gets[demanding] >= equal[demanding] * (1 - 1e-9) - 1e-6).all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(demand_tables(max_clients=12), st.floats(1, 2000))
+def test_group_cap_monotone(table, cap2):
+    """Tightening the group cap never increases the group's usage, and
+    usage never exceeds the cap."""
+    wants, has, sub, capacity = table
+    n = len(wants)
+    K = 16
+    pad = lambda xs: np.pad(np.asarray(xs, np.float64), (0, K - n))
+    active = np.zeros(K, bool)
+    active[:n] = True
+
+    def usage(group_cap):
+        batch = PriorityBatch(
+            wants=jnp.asarray(pad(wants))[None, :],
+            weights=jnp.asarray(pad(sub))[None, :],
+            band=jnp.zeros((1, K), jnp.int32),
+            active=jnp.asarray(active)[None, :],
+            capacity=jnp.asarray([capacity], jnp.float64),
+            group=jnp.asarray([0], jnp.int32),
+            group_cap=jnp.asarray([group_cap], jnp.float64),
+        )
+        return float(
+            np.asarray(solve_priority(batch, num_bands=1)).sum()
+        )
+
+    lo_cap, hi_cap = sorted([cap2, cap2 * 2])
+    u_lo, u_hi = usage(lo_cap), usage(hi_cap)
+    assert u_lo <= lo_cap * (1 + 1e-9) + 1e-6
+    assert u_hi <= hi_cap * (1 + 1e-9) + 1e-6
+    assert u_lo <= u_hi * (1 + 1e-9) + 1e-6
