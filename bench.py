@@ -199,9 +199,15 @@ def main() -> None:
             jax.device_get(out)
         return time.perf_counter() - start
 
-    elapsed = min(run_once() for _ in range(RUNS))
+    per_tick_ms = sorted(
+        run_once() / TICKS * 1000.0 for _ in range(RUNS)
+    )
 
-    ms = elapsed / TICKS * 1000.0
+    # Best-of-N is the headline (the shared tunnel link is bursty and
+    # best isolates the framework's steady state), with the selection
+    # rule explicit and median/mean alongside for run-over-run
+    # comparability.
+    ms = per_tick_ms[0]
     print(
         json.dumps(
             {
@@ -211,10 +217,156 @@ def main() -> None:
                 "value": round(ms, 3),
                 "unit": "ms",
                 "vs_baseline": round(TARGET_MS / ms, 3),
+                "selection": f"best_of_{RUNS}",
+                "median_ms": round(
+                    float(np.median(per_tick_ms)), 3
+                ),
+                "mean_ms": round(float(np.mean(per_tick_ms)), 3),
             }
         )
     )
 
 
+def bench_server_tick() -> None:
+    """Second metric: the REAL server tick end-to-end at 1M leases.
+
+    Unlike the headline loop (device as store of record), this measures
+    the batch server's actual hot path with the native C++ engine as the
+    store of record, exactly as server.py's tick loop runs it
+    (replacing reference go/server/doorman/server.go:732-817):
+
+      BatchSolver.prepare  — expiry sweep + one dm_pack C call + pad +
+                             upload                       (host+link)
+      BatchSolver.solve    — one XLA executable, then the grant table
+                             downloads in overlapping chunks   (device+link)
+      BatchSolver.apply    — one dm_apply C call writes every lease's
+                             grant + fresh expiry back        (host)
+
+    Prints one JSON line with the per-phase breakdown. Steady state:
+    2 warm-up ticks (compile), then TICKS timed ticks, median reported.
+    """
+    import jax
+
+    from doorman_tpu import native
+    from doorman_tpu.core.resource import Resource
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.solver.batch import BatchSolver
+
+    device = jax.devices()[0]
+    if device.platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
+
+    R, C = NUM_RESOURCES, CLIENTS_PER_RESOURCE
+    rng = np.random.default_rng(11)
+    engine = native.StoreEngine()
+    kind_choices = np.array(
+        [
+            pb.Algorithm.NO_ALGORITHM,
+            pb.Algorithm.STATIC,
+            pb.Algorithm.PROPORTIONAL_SHARE,
+            pb.Algorithm.FAIR_SHARE,
+        ],
+        dtype=np.int64,
+    )
+    kinds = rng.choice(kind_choices, size=R, p=[0.05, 0.05, 0.65, 0.25])
+    capacity = rng.integers(100, 100_000, R).astype(np.float64)
+
+    resources = []
+    rids = np.empty(R * C, np.int32)
+    for r in range(R):
+        tpl = pb.ResourceTemplate(
+            identifier_glob=f"res{r}",
+            capacity=float(capacity[r]),
+            algorithm=pb.Algorithm(
+                kind=int(kinds[r]), lease_length=600, refresh_interval=16
+            ),
+        )
+        res = Resource(f"res{r}", tpl, store_factory=engine.store)
+        resources.append(res)
+        rids[r * C : (r + 1) * C] = res.store._rid
+
+    # 1M distinct clients, C per resource, loaded in one bulk call.
+    cids = np.array(
+        [engine.client_handle(f"c{i}") for i in range(R * C)], np.int64
+    )
+    wants = rng.integers(0, 100, R * C).astype(np.float64)
+    now = time.time()
+    engine.bulk_assign(
+        rids,
+        cids,
+        np.full(R * C, now + 600.0),
+        np.full(R * C, 16.0),
+        np.zeros(R * C),
+        wants,
+        np.ones(R * C, np.int32),
+    )
+
+    solver = BatchSolver(dtype=dtype, device=device)
+
+    def one_tick():
+        t0 = time.perf_counter()
+        snap = solver.prepare(resources)
+        t1 = time.perf_counter()
+        gets = solver.solve(snap)
+        t2 = time.perf_counter()
+        solver.apply(resources, snap, gets, return_grants=False)
+        t3 = time.perf_counter()
+        return t1 - t0, t2 - t1, t3 - t2
+
+    one_tick()  # compile
+    # Spot-check the tick against the numpy oracle: after the first
+    # tick has==grants computed from (capacity, wants, has=0).
+    from doorman_tpu.algorithms import tick as oracle
+
+    for r in rng.integers(0, R, 10):
+        res = resources[r]
+        st = [res.store.get(f"c{i}") for i in range(r * C, (r + 1) * C)]
+        w = np.array([lease.wants for lease in st])
+        g = np.array([lease.has for lease in st])
+        k = int(kinds[r])
+        c = float(capacity[r])
+        if k == pb.Algorithm.NO_ALGORITHM:
+            expected = oracle.none_tick(w)
+        elif k == pb.Algorithm.STATIC:
+            expected = oracle.static_tick(c, w)
+        elif k == pb.Algorithm.PROPORTIONAL_SHARE:
+            expected = oracle.proportional_snapshot(c, w, np.zeros_like(w))
+        else:
+            expected = oracle.fair_share_waterfill(c, w, np.ones_like(w))
+        np.testing.assert_allclose(
+            g, expected, rtol=2e-6, atol=1e-4, err_msg=f"res{r} kind {k}"
+        )
+    one_tick()  # steady-state warm-up (has now chains)
+
+    phases = [one_tick() for _ in range(TICKS_SERVER)]
+    total_ms = sorted(sum(p) * 1000.0 for p in phases)
+    med = float(np.median(total_ms))
+    prep_ms = float(np.median([p[0] for p in phases])) * 1000.0
+    solve_ms = float(np.median([p[1] for p in phases])) * 1000.0
+    apply_ms = float(np.median([p[2] for p in phases])) * 1000.0
+    print(
+        json.dumps(
+            {
+                "metric": "server_tick_1m_leases_native_store_wall_ms",
+                "value": round(med, 3),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_MS / med, 3),
+                "selection": f"median_of_{TICKS_SERVER}",
+                "best_ms": round(total_ms[0], 3),
+                "prepare_ms": round(prep_ms, 3),
+                "solve_ms": round(solve_ms, 3),
+                "apply_ms": round(apply_ms, 3),
+            }
+        )
+    )
+
+
+TICKS_SERVER = 7
+
+
 if __name__ == "__main__":
     main()
+    bench_server_tick()

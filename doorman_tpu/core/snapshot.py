@@ -59,10 +59,24 @@ class Snapshot:
     edge_keys: List[Tuple[str, str]]  # (resource_id, client_id)
     resource_ids: List[str]
     num_edges: int
+    # Per-segment learning flags as captured at pack time (parallel to
+    # resource_ids); apply() keeps the store's live `has` for these
+    # instead of the snapshot-stale solved value.
+    learning: "List[bool] | None" = None
     # Native pack only:
     engine: object = None
     ridx: "np.ndarray | None" = None  # [num_edges] segment per edge
     cids: "np.ndarray | None" = None  # [num_edges] client handles
+    # Dense-layout pack (BatchSolver engine path): the [R, K] DenseBatch
+    # plus the host-side lane index `pos` (parallel to ridx) and the
+    # filled extent (n_rows, kfill) — the download slices to the filled
+    # region and the flat-edge gather runs host-side (a 1M-element
+    # device gather serializes on TPU; a numpy fancy index does not).
+    # When set, `edges`/`resources` are None — the dense solve replaces
+    # the edge-list executable.
+    dense: object = None
+    pos: "np.ndarray | None" = None
+    dense_fill: "Tuple[int, int] | None" = None
     # PRIORITY_BANDS resources ride in their own dense part (built and
     # consumed by BatchSolver; None when the tick has none).
     priority_part: object = None
@@ -192,6 +206,7 @@ def pack_edge_arrays(
         edge_keys=edge_keys or [],
         resource_ids=[s.resource_id for s in specs],
         num_edges=n,
+        learning=[bool(s.learning) for s in specs],
         engine=engine,
         ridx=rid if engine is not None else None,
         cids=cids,

@@ -124,7 +124,9 @@ def main(argv=None) -> None:
         if args.metrics_port >= 0:
             from doorman_tpu.obs.debug import DebugServer
 
-            debug = DebugServer(host="", port=args.metrics_port)
+            # Bind the debug pages to the same interface as the serving
+            # socket — don't expose them more broadly than the target.
+            debug = DebugServer(host=args.host, port=args.metrics_port)
             log.info("metrics on port %d", debug.start())
         await asyncio.Event().wait()
 

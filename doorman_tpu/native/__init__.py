@@ -75,6 +75,11 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
         ctypes.c_int64,
     ]
+    lib.dm_bulk_assign.restype = ctypes.c_int64
+    lib.dm_bulk_assign.argtypes = [
+        ctypes.c_void_p, _I32P, _I64P, _F64P, _F64P, _F64P, _F64P, _I32P,
+        _I64P, ctypes.c_int64,
+    ]
     lib.dm_release.restype = ctypes.c_int32
     lib.dm_release.argtypes = [ctypes.c_void_p, ctypes.c_int32,
                                ctypes.c_int64]
@@ -162,7 +167,14 @@ class StoreEngine:
         if h is None:
             h = self._lib.dm_client(self._ptr, client_id.encode())
             self._client_handles[client_id] = h
-            assert h == len(self._client_names)
+            if h != len(self._client_names):
+                # Cross-language invariant: the C side hands out handles
+                # densely in registration order, which is what lets
+                # client_name() index a plain list. Must survive python -O.
+                raise RuntimeError(
+                    f"native client handle {h} out of sync with name table "
+                    f"size {len(self._client_names)}"
+                )
             self._client_names.append(client_id)
         return h
 
@@ -201,6 +213,41 @@ class StoreEngine:
             sub.ctypes.data_as(_F64P), prio.ctypes.data_as(_I64P), cap,
         )
         return ridx[:n], cid[:n], wants[:n], has[:n], sub[:n], prio[:n]
+
+    def bulk_assign(
+        self,
+        rids: np.ndarray,  # [n] engine resource handles per lease
+        cids: np.ndarray,  # [n] client handles
+        expiry: np.ndarray,  # [n] absolute expiry stamps
+        refresh: np.ndarray,  # [n]
+        has: np.ndarray,  # [n]
+        wants: np.ndarray,  # [n]
+        subclients: np.ndarray,  # [n]
+        priority: "np.ndarray | None" = None,  # [n]
+    ) -> int:
+        """Bulk lease upsert in one C call (snapshot load / bench
+        population); returns the number assigned."""
+        n = len(rids)
+        rids = np.ascontiguousarray(rids, np.int32)
+        cids = np.ascontiguousarray(cids, np.int64)
+        expiry = np.ascontiguousarray(expiry, np.float64)
+        refresh = np.ascontiguousarray(refresh, np.float64)
+        has = np.ascontiguousarray(has, np.float64)
+        wants = np.ascontiguousarray(wants, np.float64)
+        subclients = np.ascontiguousarray(subclients, np.int32)
+        if priority is None:
+            priority = np.zeros(n, np.int64)
+        priority = np.ascontiguousarray(priority, np.int64)
+        return int(
+            self._lib.dm_bulk_assign(
+                self._ptr,
+                rids.ctypes.data_as(_I32P), cids.ctypes.data_as(_I64P),
+                expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
+                has.ctypes.data_as(_F64P), wants.ctypes.data_as(_F64P),
+                subclients.ctypes.data_as(_I32P),
+                priority.ctypes.data_as(_I64P), n,
+            )
+        )
 
     def apply(
         self,

@@ -67,6 +67,28 @@ struct Engine {
   int64_t next_client = 0;
 };
 
+// Shared upsert body (dm_assign and dm_bulk_assign): insert or replace
+// the client's lease, maintaining the running aggregates by delta.
+// Returns 1 if the client already held a lease, 0 if new.
+inline int32_t upsert(ResourceStore &r, int64_t cid, const Lease &fresh) {
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) {
+    r.index.emplace(cid, r.clients.size());
+    r.clients.push_back(cid);
+    r.leases.push_back(fresh);
+    r.sum_has += fresh.has;
+    r.sum_wants += fresh.wants;
+    r.count += fresh.subclients;
+    return 0;
+  }
+  Lease &l = r.leases[it->second];
+  r.sum_has += fresh.has - l.has;
+  r.sum_wants += fresh.wants - l.wants;
+  r.count += fresh.subclients - l.subclients;
+  l = fresh;
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -99,25 +121,25 @@ int64_t dm_client(Engine *e, const char *id) {
 int32_t dm_assign(Engine *e, int32_t rid, int64_t cid, double expiry,
                   double refresh_interval, double has, double wants,
                   int32_t subclients, int64_t priority) {
-  ResourceStore &r = e->resources[rid];
-  const Lease fresh{expiry, refresh_interval, has, wants, subclients,
-                    priority};
-  auto it = r.index.find(cid);
-  if (it == r.index.end()) {
-    r.index.emplace(cid, r.clients.size());
-    r.clients.push_back(cid);
-    r.leases.push_back(fresh);
-    r.sum_has += has;
-    r.sum_wants += wants;
-    r.count += subclients;
-    return 0;
+  return upsert(e->resources[rid], cid,
+                Lease{expiry, refresh_interval, has, wants, subclients,
+                      priority});
+}
+
+// Bulk upsert: one call assigns n leases (snapshot load / state
+// transfer; the per-call ctypes overhead of dm_assign dominates it for
+// large n). rid[i] are engine resource handles per edge. Returns n.
+int64_t dm_bulk_assign(Engine *e, const int32_t *rid, const int64_t *cid,
+                       const double *expiry, const double *refresh,
+                       const double *has, const double *wants,
+                       const int32_t *subclients, const int64_t *priority,
+                       int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    upsert(e->resources[rid[i]], cid[i],
+           Lease{expiry[i], refresh[i], has[i], wants[i], subclients[i],
+                 priority[i]});
   }
-  Lease &l = r.leases[it->second];
-  r.sum_has += has - l.has;
-  r.sum_wants += wants - l.wants;
-  r.count += subclients - l.subclients;
-  l = fresh;
-  return 1;
+  return n;
 }
 
 // Returns 1 if the client held a lease (now removed), else 0.

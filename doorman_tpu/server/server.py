@@ -214,6 +214,17 @@ class CapacityServer(CapacityServicer):
         """Install a new ResourceRepository (validating it); the first load
         also enters the election (server.go:187-218)."""
         config_mod.validate_repository(repo)
+        if repo.groups and self.mode != "batch":
+            # Shared upstream caps are enforced only by the batched
+            # priority solve; accepting them in immediate mode would
+            # silently overcommit the grouped resources.
+            log.warning(
+                "config defines %d capacity group(s) but server mode is "
+                "%r: group caps are enforced only in batch mode and will "
+                "NOT be applied",
+                len(repo.groups),
+                self.mode,
+            )
         first_time = self.config is None
         self.config = repo
         self._push_groups()

@@ -32,6 +32,82 @@ from doorman_tpu.core.snapshot import (
     pack_snapshot,
 )
 from doorman_tpu.solver.kernels import solve_tick_jit
+from doorman_tpu.utils.transfer import chunked_device_get
+
+# Engine-packed ticks use the dense [R, K] layout up to this bucket
+# width; a resource with more clients than this drops the whole tick to
+# the edge-list executable (correct everywhere, slower on TPU).
+DENSE_MAX_K = 4096
+
+
+def _dense_solver(use_pallas: bool):
+    """Jitted dense solve with the output sliced to the filled extent
+    inside the same executable (one dispatch, download-sized output)."""
+    fn = _dense_solvers.get(use_pallas)
+    if fn is None:
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+            solve = solve_dense_pallas
+        else:
+            from doorman_tpu.solver.dense import solve_dense
+
+            solve = solve_dense
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1, 2))
+        def fn(dense, n_rows, kfill):
+            return solve(dense)[:n_rows, :kfill]
+
+        _dense_solvers[use_pallas] = fn
+    return fn
+
+
+_dense_solvers: Dict[bool, Callable] = {}
+
+
+def _rebuild_grant_map(
+    engine,
+    by_id: Dict[str, Resource],
+    resource_ids: List[str],
+    ridx: np.ndarray,
+    cids: np.ndarray,
+    applied: np.ndarray,
+    flat: np.ndarray,
+    keep_has: np.ndarray,
+    out: Dict[str, Dict[str, float]],
+) -> None:
+    """Rebuild {resource: {client: grant}} from an engine.apply result;
+    learning-mode (keep_has) segments report the store's live has."""
+    name = engine.client_name
+    for i in np.nonzero(applied)[0]:
+        seg = int(ridx[i])
+        resource_id = resource_ids[seg]
+        client_id = name(int(cids[i]))
+        if keep_has[seg]:
+            grant = by_id[resource_id].store.get(client_id).has
+        else:
+            grant = float(flat[i])
+        out.setdefault(resource_id, {})[client_id] = grant
+
+
+def _committed_platform(arr) -> str:
+    """Platform of the device an array is committed to (the default
+    backend may differ, e.g. a CPU-pinned solver on a TPU host)."""
+    try:
+        return next(iter(arr.devices())).platform
+    except Exception:
+        return jax.default_backend()
+
+
+def _round_rows(n: int) -> int:
+    """Dense row padding: powers of two while small (few compile
+    variants), multiples of 1024 beyond (padding waste bounded at ~10%
+    instead of ~2x)."""
+    if n <= 1024:
+        return _bucket(max(n, 1), 16)
+    return -(-n // 1024) * 1024
 
 
 @dataclass
@@ -139,17 +215,33 @@ class BatchSolver:
         engine = _shared_native_engine(stores) if stores else None
         if engine is not None:
             ridx, cid, wants, has, sub, _prio = engine.pack(stores)
-            snap = pack_edge_arrays(
-                specs,
-                ridx,
-                wants.astype(self._dtype, copy=False),
-                has.astype(self._dtype, copy=False),
-                sub.astype(self._dtype, copy=False),
-                dtype=self._dtype,
-                to_device=self._to_device,
-                engine=engine,
-                cids=cid,
+            counts = (
+                np.bincount(ridx, minlength=len(specs))
+                if len(ridx)
+                else np.zeros(len(specs), np.int64)
             )
+            kmax = int(counts.max()) if len(counts) else 0
+            if len(ridx) and kmax <= DENSE_MAX_K:
+                # TPU-optimal layout: [R, K] rows solve as pure
+                # elementwise + row reductions (no scatter — the edge
+                # executable's segment sums serialize on TPU at ~1M
+                # edges), then an on-device gather restores flat edge
+                # order so only num_edges floats cross the link.
+                snap = self._pack_dense(
+                    specs, ridx, cid, wants, has, sub, counts, engine
+                )
+            else:
+                snap = pack_edge_arrays(
+                    specs,
+                    ridx,
+                    wants.astype(self._dtype, copy=False),
+                    has.astype(self._dtype, copy=False),
+                    sub.astype(self._dtype, copy=False),
+                    dtype=self._dtype,
+                    to_device=self._to_device,
+                    engine=engine,
+                    cids=cid,
+                )
             snap.priority_part = part
             return snap
 
@@ -165,6 +257,80 @@ class BatchSolver:
         )
         snap.priority_part = part
         return snap
+
+    def _pack_dense(
+        self,
+        specs: List[ResourceSpec],
+        ridx: np.ndarray,
+        cid: np.ndarray,
+        wants: np.ndarray,
+        has: np.ndarray,
+        sub: np.ndarray,
+        counts: np.ndarray,
+        engine: object,
+    ) -> Snapshot:
+        """Scatter the engine's flat edge arrays into the [R, K] dense
+        layout (rows filled contiguously from lane 0, resource-major
+        order preserved)."""
+        from doorman_tpu.solver.dense import DenseBatch
+
+        dtype = self._dtype
+        n_spec = len(specs)
+        R = _round_rows(n_spec)
+        K = _bucket(int(counts.max()), 8)
+        starts = np.zeros(n_spec + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        pos = np.arange(len(ridx), dtype=np.int64) - starts[ridx]
+
+        w = np.zeros((R, K), dtype)
+        h = np.zeros((R, K), dtype)
+        s = np.zeros((R, K), dtype)
+        act = np.zeros((R, K), bool)
+        w[ridx, pos] = wants
+        h[ridx, pos] = has
+        s[ridx, pos] = sub
+        act[ridx, pos] = True
+
+        cap = np.zeros(R, dtype)
+        kind = np.zeros(R, np.int32)
+        learn = np.zeros(R, bool)
+        statc = np.zeros(R, dtype)
+        for i, spec in enumerate(specs):
+            cap[i] = spec.capacity
+            kind[i] = int(spec.algo_kind)
+            learn[i] = spec.learning
+            statc[i] = spec.static_capacity
+
+        dev = self._to_device
+        dense = DenseBatch(
+            wants=dev(w),
+            has=dev(h),
+            subclients=dev(s),
+            active=dev(act),
+            capacity=dev(cap),
+            algo_kind=dev(kind),
+            learning=dev(learn),
+            static_capacity=dev(statc),
+        )
+        # Download slice: rows and lanes round up to multiples of 8 so
+        # the solve executable (shaped by these static args) does not
+        # recompile every time a resource or client count drifts by one.
+        n_rows = min(R, -(-n_spec // 8) * 8)
+        kfill = min(K, -(-int(counts.max()) // 8) * 8)
+        return Snapshot(
+            edges=None,
+            resources=None,
+            edge_keys=[],
+            resource_ids=[spec.resource_id for spec in specs],
+            num_edges=len(ridx),
+            learning=[bool(spec.learning) for spec in specs],
+            engine=engine,
+            ridx=ridx,
+            cids=cid,
+            dense=dense,
+            pos=pos,
+            dense_fill=(n_rows, kfill),
+        )
 
     def _snapshot_priority(
         self, prio_res: List[Resource]
@@ -287,17 +453,33 @@ class BatchSolver:
             # on TPU the banded water-fill runs as the fused VMEM kernel
             # (f32 only — Mosaic does not lower f64).
             use_pallas = (
-                jax.default_backend() == "tpu"
+                _committed_platform(part.batch.wants) == "tpu"
                 and part.batch.wants.dtype == jnp.float32
             )
             prio_gets = solve_priority(
                 part.batch, num_bands=part.num_bands, use_pallas=use_pallas
             )
         # device_get, not np.asarray: on tunneled platforms (axon) asarray
-        # takes a pathologically slow element-wise path.
-        gets = jax.device_get(self._solve(snap.edges, snap.resources))
+        # takes a pathologically slow element-wise path. Large grant
+        # tables download as several overlapping copies — the link only
+        # streams with multiple transfers in flight.
+        if snap.dense is not None:
+            use_pallas = (
+                _committed_platform(snap.dense.wants) == "tpu"
+                and snap.dense.wants.dtype == jnp.float32
+            )
+            n_rows, kfill = snap.dense_fill
+            dense_gets = _dense_solver(use_pallas)(
+                snap.dense, n_rows, kfill
+            )
+            got = chunked_device_get(dense_gets)
+            gets = got[snap.ridx, snap.pos]
+        else:
+            gets = chunked_device_get(
+                self._solve(snap.edges, snap.resources)
+            )
         if part is not None:
-            part.gets = jax.device_get(prio_gets)
+            part.gets = chunked_device_get(prio_gets)
         return gets
 
     def apply(
@@ -323,6 +505,11 @@ class BatchSolver:
             )
         else:
             out = {}
+            learn_ids = {
+                rid
+                for rid, flag in zip(snap.resource_ids, snap.learning or [])
+                if flag
+            }
             for (resource_id, client_id), grant in snap.unpack(
                 gets[: snap.num_edges]
             ).items():
@@ -331,6 +518,11 @@ class BatchSolver:
                     continue
                 algo = res.template.algorithm
                 old = res.store.get(client_id)
+                if resource_id in learn_ids:
+                    # Learning mode replays the client's reported has; use
+                    # the store's live value, not the snapshot-stale copy
+                    # the solve saw (a report landing mid-solve wins).
+                    grant = old.has
                 res.store.assign(
                     client_id,
                     float(algo.lease_length),
@@ -421,16 +613,10 @@ class BatchSolver:
         )
         if not return_grants:
             return
-        name = engine.client_name
-        for i in np.nonzero(applied)[0]:
-            seg = int(part.ridx[i])
-            resource_id = part.resource_ids[seg]
-            client_id = name(int(part.cids[i]))
-            if keep_has[seg]:
-                grant = by_id[resource_id].store.get(client_id).has
-            else:
-                grant = float(flat[i])
-            out.setdefault(resource_id, {})[client_id] = grant
+        _rebuild_grant_map(
+            engine, by_id, part.resource_ids, part.ridx, part.cids,
+            applied, flat, keep_has, out,
+        )
 
     def _apply_native(
         self,
@@ -449,6 +635,7 @@ class BatchSolver:
         order = np.full(n_seg, -1, np.int32)
         expiry = np.zeros(n_seg, np.float64)
         refresh = np.zeros(n_seg, np.float64)
+        keep_has = np.zeros(n_seg, np.uint8)
         for i, resource_id in enumerate(snap.resource_ids):
             res = by_id.get(resource_id)
             if res is None:
@@ -459,19 +646,22 @@ class BatchSolver:
             order[i] = res.store._rid
             expiry[i] = now + float(algo.lease_length)
             refresh[i] = float(algo.refresh_interval)
+            if snap.learning and snap.learning[i]:
+                # Learning mode: refresh the expiry but keep the store's
+                # live has (a client report landing mid-solve wins over
+                # the snapshot-stale replay the solve produced).
+                keep_has[i] = 1
         flat = np.asarray(gets[: snap.num_edges], np.float64)
         applied = engine.apply(
-            order, snap.ridx, snap.cids, flat, expiry, refresh
+            order, snap.ridx, snap.cids, flat, expiry, refresh, keep_has
         )
         out: Dict[str, Dict[str, float]] = {}
         if not return_grants:
             return out
-        name = engine.client_name
-        for i in np.nonzero(applied)[0]:
-            resource_id = snap.resource_ids[int(snap.ridx[i])]
-            out.setdefault(resource_id, {})[name(int(snap.cids[i]))] = float(
-                flat[i]
-            )
+        _rebuild_grant_map(
+            engine, by_id, snap.resource_ids, snap.ridx, snap.cids,
+            applied, flat, keep_has, out,
+        )
         return out
 
     def tick(self, resources: Iterable[Resource]) -> Dict[str, Dict[str, float]]:
