@@ -104,6 +104,12 @@ class CapacityServer(CapacityServicer):
                 )
 
         self.resources: Dict[str, Resource] = {}
+        # Band composition of each downstream server's last request,
+        # keyed (resource_id, server_id) -> set of wire priorities; used
+        # to release band sub-leases the server stopped reporting (the
+        # reference replaces the whole band list per request,
+        # simulation/server.py:303-306).
+        self._server_bands: Dict[tuple, set] = {}
         self.is_master = False
         self.became_master_at: float = 0.0
         self.current_master = ""
@@ -261,6 +267,7 @@ class CapacityServer(CapacityServicer):
             log.warning("%s: this server lost mastership", self.id)
             self.became_master_at = 0.0
         self.resources = {}
+        self._server_bands = {}
         self._reset_store_engine()
 
     async def _on_current_master(self, master: str) -> None:
@@ -442,27 +449,49 @@ class CapacityServer(CapacityServicer):
                 err = True
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
             for req in request.resource:
-                wants_total = sum(band.wants for band in req.wants)
-                subclients_total = sum(band.num_clients for band in req.wants)
-                has = req.has.capacity if req.HasField("has") else 0.0
-                # The aggregated request represents its highest band: a
-                # PRIORITY_BANDS parent serves servers carrying important
-                # clients first (band detail stays at the leaf).
-                priority = max(
-                    (band.priority for band in req.wants), default=0
-                )
-                lease, res = self._decide(
-                    req.resource_id,
-                    Request(
-                        request.server_id, has, wants_total,
-                        max(subclients_total, 1), priority=priority,
-                    ),
-                )
+                # One sub-lease per priority band: the store keeps the
+                # downstream server's band composition (reference
+                # carries sr.wants as the full band list,
+                # simulation/server.py:300-306), so a PRIORITY_BANDS
+                # resource discriminates bands across the tree and this
+                # server's own upstream aggregation re-emits them.
+                bands = list(req.wants) or [
+                    pb.PriorityBandAggregate(
+                        priority=DEFAULT_PRIORITY, num_clients=1, wants=0.0
+                    )
+                ]
+                wants_total = sum(band.wants for band in bands)
+                has_total = req.has.capacity if req.HasField("has") else 0.0
+                res = self.get_or_create_resource(req.resource_id)
+                key = (req.resource_id, request.server_id)
+                prios = {band.priority for band in bands}
+                for stale in self._server_bands.get(key, set()) - prios:
+                    res.release(_band_key(request.server_id, stale))
+                self._server_bands[key] = prios
+                granted, lease = 0.0, None
+                for band in bands:
+                    # The reported has splits across bands in proportion
+                    # to their demand (the wire carries one aggregate
+                    # has per resource).
+                    if wants_total > 0:
+                        has_band = has_total * (band.wants / wants_total)
+                    else:
+                        has_band = has_total / len(bands)
+                    lease, res = self._decide(
+                        req.resource_id,
+                        Request(
+                            _band_key(request.server_id, band.priority),
+                            has_band, band.wants,
+                            max(band.num_clients, 1),
+                            priority=band.priority,
+                        ),
+                    )
+                    granted += lease.has
                 resp = out.response.add()
                 resp.resource_id = req.resource_id
                 resp.gets.expiry_time = int(lease.expiry)
                 resp.gets.refresh_interval = int(lease.refresh_interval)
-                resp.gets.capacity = lease.has
+                resp.gets.capacity = granted
                 resp.algorithm.CopyFrom(res.template.algorithm)
                 resp.safe_capacity = (
                     res.template.safe_capacity
