@@ -161,11 +161,32 @@ def validate_get_capacity_request(req: pb.GetCapacityRequest) -> Optional[str]:
     (mirrors server.go:357-381)."""
     if not req.client_id:
         return "client_id cannot be empty"
+    if _has_control_chars(req.client_id):
+        return "client_id cannot contain control characters"
     for r in req.resource:
         if not r.resource_id:
             return "resource_id cannot be empty"
+        if _has_control_chars(r.resource_id):
+            return "resource_id cannot contain control characters"
         if r.wants < 0:
             return "capacity must be positive"
+    return None
+
+
+def _has_control_chars(s: str) -> bool:
+    # Control characters in ids could forge the server's internal band
+    # sub-lease keys (server._BAND_SEP) or break C-string interning in
+    # the native store engine.
+    return any(c < " " for c in s)
+
+
+def validate_release_capacity_request(
+    req: pb.ReleaseCapacityRequest,
+) -> Optional[str]:
+    if not req.client_id:
+        return "client_id cannot be empty"
+    if _has_control_chars(req.client_id):
+        return "client_id cannot contain control characters"
     return None
 
 
@@ -176,9 +197,13 @@ def validate_get_server_capacity_request(
     checks exercised by reference server_test.go:483-553)."""
     if not req.server_id:
         return "server_id cannot be empty"
+    if _has_control_chars(req.server_id):
+        return "server_id cannot contain control characters"
     for r in req.resource:
         if not r.resource_id:
             return "resource_id cannot be empty"
+        if _has_control_chars(r.resource_id):
+            return "resource_id cannot contain control characters"
         for band in r.wants:
             if band.wants < 0:
                 return "capacity must be positive"

@@ -39,6 +39,24 @@ log = logging.getLogger(__name__)
 DEFAULT_PRIORITY = 1
 # Matching reference defaults (server.go:42-90).
 DEFAULT_INTERVAL = 1.0
+# How often (at most) GetServerCapacity scans for vanished downstream
+# servers' band-composition entries.
+BAND_SWEEP_INTERVAL = 10.0
+
+# Separator for per-band sub-lease keys. Control characters are rejected
+# by request validation (config.validate_*), so band keys never collide
+# with real clients; \x01 (not NUL) keeps the key a valid C string for
+# the native store engine's interning table.
+_BAND_SEP = "\x01band\x01"
+
+
+def _band_key(server_id: str, priority: int) -> str:
+    """Store key for one priority band of a downstream server's aggregate.
+
+    The reference keeps the full band list on each server record
+    (simulation/server.py:300-306); this design flattens each band into its
+    own sub-lease so the batched solver sees bands as ordinary rows."""
+    return f"{server_id}{_BAND_SEP}{priority}"
 
 
 def default_resource_template() -> pb.ResourceTemplate:
@@ -110,6 +128,7 @@ class CapacityServer(CapacityServicer):
         # reference replaces the whole band list per request,
         # simulation/server.py:303-306).
         self._server_bands: Dict[tuple, set] = {}
+        self._last_band_sweep = 0.0
         self.is_master = False
         self.became_master_at: float = 0.0
         self.current_master = ""
@@ -448,6 +467,7 @@ class CapacityServer(CapacityServicer):
             if msg is not None:
                 err = True
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+            self._sweep_server_bands()
             for req in request.resource:
                 # One sub-lease per priority band: the store keeps the
                 # downstream server's band composition (reference
@@ -470,18 +490,25 @@ class CapacityServer(CapacityServicer):
                 self._server_bands[key] = prios
                 granted, lease = 0.0, None
                 for band in bands:
-                    # The reported has splits across bands in proportion
-                    # to their demand (the wire carries one aggregate
-                    # has per resource).
-                    if wants_total > 0:
+                    # Per-band has: this server granted the band's previous
+                    # lease itself, so the stored value is exact; the
+                    # wants-proportional split of the aggregate wire `has`
+                    # only seeds bands we have no record of (the wire
+                    # carries one aggregate has per resource).
+                    bkey = _band_key(request.server_id, band.priority)
+                    prev = res.store.get(bkey)
+                    if res.store.has_client(bkey) and (
+                        prev.expiry >= self._clock()
+                    ):
+                        has_band = prev.has
+                    elif wants_total > 0:
                         has_band = has_total * (band.wants / wants_total)
                     else:
                         has_band = has_total / len(bands)
                     lease, res = self._decide(
                         req.resource_id,
                         Request(
-                            _band_key(request.server_id, band.priority),
-                            has_band, band.wants,
+                            bkey, has_band, band.wants,
                             max(band.num_clients, 1),
                             priority=band.priority,
                         ),
@@ -514,22 +541,60 @@ class CapacityServer(CapacityServicer):
     async def ReleaseCapacity(self, request, context):
         start = self._clock()
         out = pb.ReleaseCapacityResponse()
+        err = False
         try:
             if not self.is_master:
                 out.mastership.CopyFrom(self._mastership())
                 return out
+            msg = config_mod.validate_release_capacity_request(request)
+            if msg is not None:
+                err = True
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
             for resource_id in request.resource_id:
                 res = self.resources.get(resource_id)
-                if res is not None:
-                    res.release(request.client_id)
+                if res is None:
+                    continue
+                res.release(request.client_id)
+                # A downstream *server* holds per-band sub-leases; release
+                # them too and forget its band composition.
+                key = (resource_id, request.client_id)
+                for prio in self._server_bands.pop(key, set()):
+                    res.release(_band_key(request.client_id, prio))
             return out
         finally:
-            self.on_request("ReleaseCapacity", self._clock() - start, False)
+            self.on_request("ReleaseCapacity", self._clock() - start, err)
             self.request_log.record(
                 "ReleaseCapacity", request.client_id,
                 list(request.resource_id), 0.0,
-                self._clock() - start, False,
+                self._clock() - start, err,
             )
+
+    def _sweep_server_bands(self) -> None:
+        """Drop (resource, server) band-composition entries whose sub-leases
+        have all expired out of the store — downstream servers that vanished
+        without a ReleaseCapacity would otherwise leak an entry forever.
+        Time-gated: the underlying expiry state only changes as leases
+        lapse, so scanning more than once per interval buys nothing."""
+        now = self._clock()
+        if now - self._last_band_sweep < BAND_SWEEP_INTERVAL:
+            return
+        self._last_band_sweep = now
+        stale = []
+        swept = set()
+        for (resource_id, server_id), prios in self._server_bands.items():
+            res = self.resources.get(resource_id)
+            if res is not None and resource_id not in swept:
+                # has_client counts expired leases as live; sweep them out
+                # first so vanished servers actually disappear even in
+                # immediate mode (where no batch tick cleans stores).
+                res.store.clean()
+                swept.add(resource_id)
+            if res is None or not any(
+                res.store.has_client(_band_key(server_id, p)) for p in prios
+            ):
+                stale.append((resource_id, server_id))
+        for key in stale:
+            del self._server_bands[key]
 
     def _decide(self, resource_id: str, request: Request):
         """Produce a lease for one resource request. Immediate mode (and
@@ -562,17 +627,35 @@ class CapacityServer(CapacityServicer):
     # ------------------------------------------------------------------
 
     def _build_server_capacity_request(self) -> pb.GetServerCapacityRequest:
-        """Aggregate every local resource into a single-band request
-        (server.go:227-261)."""
+        """Aggregate every local resource into per-band aggregates.
+
+        Clients and downstream servers' bands group by wire priority
+        (simulation/server_state_wrapper.py:305-334 — the Go server's
+        single-band pack at server.go:227-261 is its own documented TODO),
+        so band structure survives every upstream hop. The current parent
+        lease rides along as `has` so the parent's algorithms see this
+        server as a returning client."""
         out = pb.GetServerCapacityRequest(server_id=self.id)
         for resource_id, res in self.resources.items():
             if res.store.sum_wants > 0:
                 rr = out.resource.add()
                 rr.resource_id = resource_id
-                band = rr.wants.add()
-                band.priority = DEFAULT_PRIORITY
-                band.num_clients = max(res.store.count, 1)
-                band.wants = res.store.sum_wants
+                if res.parent_expiry is not None and res.capacity > 0:
+                    rr.has.capacity = res.capacity
+                    rr.has.expiry_time = int(res.parent_expiry)
+                bands: Dict[int, List[float]] = {}
+                for _client, lease in res.store.items():
+                    acc = bands.setdefault(lease.priority, [0.0, 0])
+                    acc[0] += lease.wants
+                    acc[1] += lease.subclients
+                for priority in sorted(bands):
+                    wants, num_clients = bands[priority]
+                    if wants <= 0:
+                        continue
+                    band = rr.wants.add()
+                    band.priority = priority
+                    band.num_clients = max(int(num_clients), 1)
+                    band.wants = wants
         if not out.resource:
             # Probe request so the parent link stays warm (server.go:66-79).
             rr = out.resource.add()

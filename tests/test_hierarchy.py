@@ -15,7 +15,7 @@ from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.proto.grpc_api import CapacityStub
 from doorman_tpu.server.config import parse_yaml_config
 from doorman_tpu.server.election import TrivialElection
-from doorman_tpu.server.server import CapacityServer
+from doorman_tpu.server.server import CapacityServer, _band_key
 
 ROOT_CONFIG = """
 resources:
@@ -25,16 +25,25 @@ resources:
               learning_mode_duration: 0}
 """
 
+BANDED_ROOT_CONFIG = """
+resources:
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PRIORITY_BANDS, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
 
 def run(coro):
     return asyncio.run(coro)
 
 
-def capacity_request(client_id, resource_id, wants):
+def capacity_request(client_id, resource_id, wants, priority=0):
     req = pb.GetCapacityRequest(client_id=client_id)
     rr = req.resource.add()
     rr.resource_id = resource_id
     rr.wants = wants
+    rr.priority = priority
     return req
 
 
@@ -49,9 +58,9 @@ async def make_root():
     return root, f"127.0.0.1:{port}"
 
 
-async def make_intermediate(root_addr):
+async def make_intermediate(root_addr, server_id="intermediate"):
     mid = CapacityServer(
-        "intermediate",
+        server_id,
         TrivialElection(),
         parent_addr=root_addr,
         minimum_refresh_interval=0.1,
@@ -95,11 +104,13 @@ def test_intermediate_converges_to_root_capacity():
                         break
                 assert granted == 40.0, f"never converged, last={granted}"
 
-            # The root now tracks the intermediate's aggregated demand.
+            # The root now tracks the intermediate's aggregated demand,
+            # one sub-lease per priority band (client-a sent priority 0).
             root_res = root.resources.get("res0")
             assert root_res is not None
-            assert root_res.store.has_client("intermediate")
-            assert root_res.store.get("intermediate").wants == 40.0
+            band = _band_key("intermediate", 0)
+            assert root_res.store.has_client(band)
+            assert root_res.store.get(band).wants == 40.0
         finally:
             await mid.stop()
             await root.stop()
@@ -139,6 +150,77 @@ def test_parent_grant_becomes_intermediate_capacity():
                 assert res.store.sum_has <= res.capacity + 1e-9
         finally:
             await mid.stop()
+            await root.stop()
+
+    run(body())
+
+
+def test_priority_bands_flow_through_two_hops():
+    """Two intermediates with different band mixes against a
+    PRIORITY_BANDS root (capacity 100, total demand 180): the high band
+    is served in full and the leftovers split evenly across the two
+    priority-1 bands, through the client->intermediate->root hops
+    (reference multi-band aggregation:
+    simulation/server_state_wrapper.py:305-334)."""
+
+    async def body():
+        root, root_addr = await make_root()
+        await root.load_config(parse_yaml_config(BANDED_ROOT_CONFIG))
+        mid1, mid1_addr = await make_intermediate(root_addr, "mid1")
+        mid2, mid2_addr = await make_intermediate(root_addr, "mid2")
+        try:
+            mid1.became_master_at -= 1000
+            mid2.became_master_at -= 1000
+            async with grpc.aio.insecure_channel(mid1_addr) as ch1, \
+                    grpc.aio.insecure_channel(mid2_addr) as ch2:
+                stub1, stub2 = CapacityStub(ch1), CapacityStub(ch2)
+                grants = {}
+                for _ in range(100):
+                    await asyncio.sleep(0.1)
+                    for mid in (mid1, mid2):
+                        res = mid.resources.get("shared")
+                        if res is not None:
+                            res.learning_mode_end = 0.0
+                    o_hi = await stub1.GetCapacity(
+                        capacity_request("hi", "shared", 60.0, priority=2)
+                    )
+                    o_lo = await stub1.GetCapacity(
+                        capacity_request("lo", "shared", 60.0, priority=1)
+                    )
+                    o_lo2 = await stub2.GetCapacity(
+                        capacity_request("lo2", "shared", 60.0, priority=1)
+                    )
+                    grants = {
+                        "hi": o_hi.response[0].gets.capacity,
+                        "lo": o_lo.response[0].gets.capacity,
+                        "lo2": o_lo2.response[0].gets.capacity,
+                    }
+                    if (
+                        abs(grants["hi"] - 60.0) < 1e-6
+                        and abs(grants["lo"] - 20.0) < 1e-6
+                        and abs(grants["lo2"] - 20.0) < 1e-6
+                    ):
+                        break
+                assert abs(grants["hi"] - 60.0) < 1e-6, grants
+                assert abs(grants["lo"] - 20.0) < 1e-6, grants
+                assert abs(grants["lo2"] - 20.0) < 1e-6, grants
+
+            # The root sees each intermediate's bands separately, at the
+            # band-correct granted amounts.
+            root_res = root.resources.get("shared")
+            assert root_res is not None
+            hi_band = root_res.store.get(_band_key("mid1", 2))
+            lo_band1 = root_res.store.get(_band_key("mid1", 1))
+            lo_band2 = root_res.store.get(_band_key("mid2", 1))
+            assert hi_band.wants == 60.0
+            assert lo_band1.wants == 60.0
+            assert lo_band2.wants == 60.0
+            assert abs(hi_band.has - 60.0) < 1e-6
+            assert abs(lo_band1.has - 20.0) < 1e-6
+            assert abs(lo_band2.has - 20.0) < 1e-6
+        finally:
+            await mid1.stop()
+            await mid2.stop()
             await root.stop()
 
     run(body())

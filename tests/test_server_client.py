@@ -116,6 +116,23 @@ def test_invalid_request_rejected():
                         capacity_request("", "proportional", 40.0)
                     )
                 assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+                # A NUL in the id could forge a downstream server's band
+                # sub-lease key; the wire rejects it.
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await stub.GetCapacity(
+                        capacity_request(
+                            "mid\x00band\x002", "proportional", 40.0
+                        )
+                    )
+                assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await stub.ReleaseCapacity(
+                        pb.ReleaseCapacityRequest(
+                            client_id="mid\x00band\x002",
+                            resource_id=["proportional"],
+                        )
+                    )
+                assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
         finally:
             await server.stop()
 
