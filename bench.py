@@ -230,27 +230,34 @@ def main() -> None:
 def bench_server_tick() -> None:
     """Second metric: the REAL server tick end-to-end at 1M leases.
 
-    Unlike the headline loop (device as store of record), this measures
-    the batch server's actual hot path with the native C++ engine as the
+    Unlike the headline loop (a synthetic table), this measures the
+    batch server's actual hot path with the native C++ engine as the
     store of record, exactly as server.py's tick loop runs it
-    (replacing reference go/server/doorman/server.go:732-817):
+    (replacing reference go/server/doorman/server.go:732-817), through
+    the device-resident solver (solver/resident.py):
 
-      BatchSolver.prepare  — expiry sweep + one dm_pack C call + pad +
-                             upload                       (host+link)
-      BatchSolver.solve    — one XLA executable, then the grant table
-                             downloads in overlapping chunks   (device+link)
-      BatchSolver.apply    — one dm_apply C call writes every lease's
-                             grant + fresh expiry back        (host)
+      dispatch — expiry sweep (one dm_clean_all C call), drain the
+                 engine's dirty-row list, pack + upload ONLY the rows
+                 whose demand changed (5% churn per tick, applied
+                 between ticks as the RPC handlers would), launch the
+                 full-table solve, start the grant download for the
+                 delivery set (dirty rows + the rotation slice that
+                 rides the 16s refresh cadence);
+      collect  — download lands, one dm_apply_dense C call writes
+                 grants + fresh expiries back.
 
-    Prints one JSON line with the per-phase breakdown. Steady state:
-    2 warm-up ticks (compile), then TICKS timed ticks, median reported.
+    PIPELINE_DEPTH_SERVER ticks stay in flight, as in the server's
+    tick loop. Steady state: warm-up ticks compile both bucket shapes,
+    then per-tick wall times are measured; median reported (best
+    alongside). The first tick (rotate=1: every grant delivered) is
+    spot-checked against the numpy oracles before any timing.
     """
     import jax
 
     from doorman_tpu import native
     from doorman_tpu.core.resource import Resource
     from doorman_tpu.proto import doorman_pb2 as pb
-    from doorman_tpu.solver.batch import BatchSolver
+    from doorman_tpu.solver.resident import ResidentDenseSolver
 
     device = jax.devices()[0]
     if device.platform == "cpu":
@@ -304,21 +311,14 @@ def bench_server_tick() -> None:
         np.ones(R * C, np.int32),
     )
 
-    solver = BatchSolver(dtype=dtype, device=device)
+    solver = ResidentDenseSolver(
+        engine, dtype=dtype, device=device,
+        rotate_ticks=1,  # first tick delivers everything (oracle check)
+    )
+    solver.step(resources)  # build + compile + full delivery
 
-    def one_tick():
-        t0 = time.perf_counter()
-        snap = solver.prepare(resources)
-        t1 = time.perf_counter()
-        gets = solver.solve(snap)
-        t2 = time.perf_counter()
-        solver.apply(resources, snap, gets, return_grants=False)
-        t3 = time.perf_counter()
-        return t1 - t0, t2 - t1, t3 - t2
-
-    one_tick()  # compile
-    # Spot-check the tick against the numpy oracle: after the first
-    # tick has==grants computed from (capacity, wants, has=0).
+    # Spot-check the first tick against the numpy oracles: after it,
+    # has == grants computed from (capacity, wants, has=0).
     from doorman_tpu.algorithms import tick as oracle
 
     for r in rng.integers(0, R, 10):
@@ -339,32 +339,78 @@ def bench_server_tick() -> None:
         np.testing.assert_allclose(
             g, expected, rtol=2e-6, atol=1e-4, err_msg=f"res{r} kind {k}"
         )
-    one_tick()  # steady-state warm-up (has now chains)
 
-    phases = [one_tick() for _ in range(TICKS_SERVER)]
-    total_ms = sorted(sum(p) * 1000.0 for p in phases)
-    med = float(np.median(total_ms))
-    prep_ms = float(np.median([p[0] for p in phases])) * 1000.0
-    solve_ms = float(np.median([p[1] for p in phases])) * 1000.0
-    apply_ms = float(np.median([p[2] for p in phases])) * 1000.0
+    # Steady state: grants rotate out on the refresh cadence
+    # (refresh_interval=16s at ~1s ticks), dirty rows deliver same-tick.
+    solver.rotate_ticks = SERVER_ROTATE_TICKS
+
+    # Pre-generate per-tick demand churn (5% of resources change wants),
+    # applied through the engine's bulk path as the RPC handlers' store
+    # writes land between ticks.
+    n_ticks = SERVER_WARMUP + TICKS_SERVER
+    churn_rows = [
+        rng.choice(R, CHURN_RESOURCES, replace=False)
+        for _ in range(n_ticks)
+    ]
+    churn_wants = [
+        rng.integers(0, 100, CHURN_RESOURCES * C).astype(np.float64)
+        for _ in range(n_ticks)
+    ]
+
+    def churn(t):
+        # A client refresh's store effect: wants update + expiry stamp,
+        # has preserved (grants are the only thing that changes has).
+        sel = churn_rows[t]
+        edge = (sel[:, None] * C + np.arange(C)).ravel()
+        engine.bulk_refresh(
+            rids[edge], cids[edge],
+            np.full(len(edge), time.time() + 600.0),
+            np.full(len(edge), 16.0),
+            churn_wants[t],
+        )
+
+    tick_ms = []
+    handles = []
+    for t in range(n_ticks):
+        t0 = time.perf_counter()
+        churn(t)
+        handles.append(solver.dispatch(resources))
+        if len(handles) >= PIPELINE_DEPTH_SERVER:
+            solver.collect(handles.pop(0))
+        tick_ms.append((time.perf_counter() - t0) * 1000.0)
+    t0 = time.perf_counter()
+    for h in handles:
+        solver.collect(h)
+    drain_ms = (time.perf_counter() - t0) * 1000.0
+    timed = sorted(
+        t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
+    )
+    med = float(np.median(timed))
     print(
         json.dumps(
             {
                 "metric": "server_tick_1m_leases_native_store_wall_ms",
                 "value": round(med, 3),
                 "unit": "ms",
-                "vs_baseline": round(TARGET_MS / med, 3),
+                "vs_baseline": round(SERVER_TICK_TARGET_MS / med, 3),
                 "selection": f"median_of_{TICKS_SERVER}",
-                "best_ms": round(total_ms[0], 3),
-                "prepare_ms": round(prep_ms, 3),
-                "solve_ms": round(solve_ms, 3),
-                "apply_ms": round(apply_ms, 3),
+                "best_ms": round(timed[0], 3),
+                "p90_ms": round(float(np.percentile(timed, 90)), 3),
+                "pipeline_depth": PIPELINE_DEPTH_SERVER,
+                "rotate_ticks": SERVER_ROTATE_TICKS,
             }
         )
     )
 
 
-TICKS_SERVER = 7
+# The server tick has its own target: the BASELINE.md north star is
+# <100 ms per recompute of the full 1M-lease table, measured here
+# end-to-end through the store of record.
+SERVER_TICK_TARGET_MS = 100.0
+SERVER_ROTATE_TICKS = 16  # grant delivery rides the 16s refresh cadence
+PIPELINE_DEPTH_SERVER = 4
+SERVER_WARMUP = 6
+TICKS_SERVER = 24
 
 
 if __name__ == "__main__":

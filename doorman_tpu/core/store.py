@@ -121,6 +121,18 @@ class LeaseStore:
         for client, lease in self._leases.items():
             fn(client, lease)
 
+    def band_aggregates(self) -> List[Tuple[int, float, int]]:
+        """(priority, wants-sum, subclient-count) per distinct priority,
+        ascending (same contract as the native store's C fast path)."""
+        bands: Dict[int, List[float]] = {}
+        for lease in self._leases.values():
+            acc = bands.setdefault(lease.priority, [0.0, 0])
+            acc[0] += lease.wants
+            acc[1] += lease.subclients
+        return [
+            (p, bands[p][0], int(bands[p][1])) for p in sorted(bands)
+        ]
+
     def lease_status(self) -> ResourceLeaseStatus:
         return ResourceLeaseStatus(
             id=self.id,

@@ -108,6 +108,31 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int64, _F64P, _F64P, ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_uint8),
     ]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.dm_clean_all.restype = ctypes.c_int64
+    lib.dm_clean_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.dm_drain_dirty.restype = ctypes.c_int64
+    lib.dm_drain_dirty.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int64]
+    lib.dm_pack_rows.argtypes = [
+        ctypes.c_void_p, _I32P, ctypes.c_int64, ctypes.c_int64,
+        _F64P, _F64P, _F64P, u8p, _I32P, u64p,
+    ]
+    lib.dm_apply_dense.restype = ctypes.c_int64
+    lib.dm_apply_dense.argtypes = [
+        ctypes.c_void_p, _I32P, ctypes.c_int64, ctypes.c_int64,
+        _F64P, _F64P, _F64P, u8p, u64p,
+    ]
+    lib.dm_band_aggregates.restype = ctypes.c_int64
+    lib.dm_band_aggregates.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, _I64P, _F64P, _I64P,
+        ctypes.c_int64,
+    ]
+    lib.dm_bulk_refresh.restype = ctypes.c_int64
+    lib.dm_bulk_refresh.argtypes = [
+        ctypes.c_void_p, _I32P, _I64P, _F64P, _F64P, _F64P,
+        ctypes.c_int64,
+    ]
 
 
 def _load() -> "ctypes.CDLL | None":
@@ -246,6 +271,109 @@ class StoreEngine:
                 has.ctypes.data_as(_F64P), wants.ctypes.data_as(_F64P),
                 subclients.ctypes.data_as(_I32P),
                 priority.ctypes.data_as(_I64P), n,
+            )
+        )
+
+    def bulk_refresh(
+        self,
+        rids: np.ndarray,  # [n] engine resource handles
+        cids: np.ndarray,  # [n] client handles
+        expiry: np.ndarray,  # [n]
+        refresh: np.ndarray,  # [n]
+        wants: np.ndarray,  # [n]
+    ) -> int:
+        """Bulk demand refresh preserving each lease's current
+        has/subclients/priority (a client refresh's store effect);
+        returns the number refreshed."""
+        rids = np.ascontiguousarray(rids, np.int32)
+        cids = np.ascontiguousarray(cids, np.int64)
+        expiry = np.ascontiguousarray(expiry, np.float64)
+        refresh = np.ascontiguousarray(refresh, np.float64)
+        wants = np.ascontiguousarray(wants, np.float64)
+        return int(
+            self._lib.dm_bulk_refresh(
+                self._ptr, rids.ctypes.data_as(_I32P),
+                cids.ctypes.data_as(_I64P),
+                expiry.ctypes.data_as(_F64P),
+                refresh.ctypes.data_as(_F64P),
+                wants.ctypes.data_as(_F64P), len(rids),
+            )
+        )
+
+    def clean_all(self, now: "float | None" = None) -> int:
+        """Engine-wide expiry sweep in one C call; returns removals."""
+        if now is None:
+            now = self._clock()
+        return int(self._lib.dm_clean_all(self._ptr, now))
+
+    def drain_dirty(self) -> np.ndarray:
+        """Resources whose solver-visible inputs changed since the last
+        drain (engine rids, int32); clears the dirty flags."""
+        chunks = []
+        while True:
+            buf = np.empty(4096, np.int32)
+            n = int(
+                self._lib.dm_drain_dirty(
+                    self._ptr, buf.ctypes.data_as(_I32P), len(buf)
+                )
+            )
+            chunks.append(buf[:n])
+            if n < len(buf):
+                break
+        return np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+    def pack_rows(self, rids: np.ndarray, K: int):
+        """Dense [n, K] row pack of the given resources: returns
+        (wants, has, subclients, active, counts, versions). counts may
+        exceed K — the caller detects bucket overflow."""
+        rids = np.ascontiguousarray(rids, np.int32)
+        n = len(rids)
+        wants = np.empty((n, K), np.float64)
+        has = np.empty((n, K), np.float64)
+        sub = np.empty((n, K), np.float64)
+        act = np.empty((n, K), np.uint8)
+        counts = np.empty(n, np.int32)
+        versions = np.empty(n, np.uint64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        self._lib.dm_pack_rows(
+            self._ptr, rids.ctypes.data_as(_I32P), n, K,
+            wants.ctypes.data_as(_F64P), has.ctypes.data_as(_F64P),
+            sub.ctypes.data_as(_F64P), act.ctypes.data_as(u8p),
+            counts.ctypes.data_as(_I32P), versions.ctypes.data_as(u64p),
+        )
+        return wants, has, sub, act, counts, versions
+
+    def apply_dense(
+        self,
+        rids: np.ndarray,  # [n] engine resource handles
+        grants: np.ndarray,  # [n, K] in upload-time slot order
+        expiry: np.ndarray,  # [n]
+        refresh: np.ndarray,  # [n]
+        keep_has: np.ndarray,  # [n] uint8
+        expected_versions: np.ndarray,  # [n] uint64
+    ) -> int:
+        """Dense grant write-back; rows whose membership epoch moved
+        since upload are skipped (they re-solve next tick). Returns the
+        number of rows applied."""
+        rids = np.ascontiguousarray(rids, np.int32)
+        grants = np.ascontiguousarray(grants, np.float64)
+        expiry = np.ascontiguousarray(expiry, np.float64)
+        refresh = np.ascontiguousarray(refresh, np.float64)
+        keep_has = np.ascontiguousarray(keep_has, np.uint8)
+        expected_versions = np.ascontiguousarray(
+            expected_versions, np.uint64
+        )
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        return int(
+            self._lib.dm_apply_dense(
+                self._ptr, rids.ctypes.data_as(_I32P), len(rids),
+                grants.shape[1], grants.ctypes.data_as(_F64P),
+                expiry.ctypes.data_as(_F64P),
+                refresh.ctypes.data_as(_F64P),
+                keep_has.ctypes.data_as(u8p),
+                expected_versions.ctypes.data_as(u64p),
             )
         )
 
@@ -403,6 +531,23 @@ class NativeLeaseStore:
     def map(self, fn: Callable[[str, Lease], None]) -> None:
         for client, lease in self.items():
             fn(client, lease)
+
+    def band_aggregates(self) -> "list[tuple[int, float, int]]":
+        """(priority, wants-sum, subclient-count) per distinct priority,
+        ascending — one C call, no per-lease Python objects (the
+        intermediate server's upstream pack at 1M leases must not walk
+        the store on the event loop)."""
+        cap = max(len(self), 1)
+        prio = np.empty(cap, np.int64)
+        wants = np.empty(cap, np.float64)
+        num = np.empty(cap, np.int64)
+        n = self._lib.dm_band_aggregates(
+            self._ptr, self._rid, prio.ctypes.data_as(_I64P),
+            wants.ctypes.data_as(_F64P), num.ctypes.data_as(_I64P), cap,
+        )
+        return [
+            (int(prio[i]), float(wants[i]), int(num[i])) for i in range(n)
+        ]
 
     def lease_status(self) -> ResourceLeaseStatus:
         sums = self._sums()

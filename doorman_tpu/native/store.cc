@@ -20,6 +20,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -42,6 +43,11 @@ struct ResourceStore {
   double sum_has = 0.0;
   double sum_wants = 0.0;
   int64_t count = 0;  // total subclients
+  // Membership epoch: bumped whenever the client->slot mapping changes
+  // (insert, release, expiry sweep). The device-resident solver records
+  // the epoch it uploaded and skips write-backs whose rows went stale
+  // while the solve was in flight.
+  uint64_t version = 0;
 
   void remove_slot(size_t slot) {
     const Lease &l = leases[slot];
@@ -57,6 +63,7 @@ struct ResourceStore {
     }
     clients.pop_back();
     leases.pop_back();
+    ++version;
   }
 };
 
@@ -65,12 +72,35 @@ struct Engine {
   std::unordered_map<std::string, int32_t> resource_ids;
   std::unordered_map<std::string, int64_t> client_ids;
   int64_t next_client = 0;
+  // Dirty tracking for delta uploads: a resource is dirty when any
+  // solver-visible input changed (wants/has/subclients/priority or
+  // membership) since the last drain. Pure expiry refreshes with
+  // unchanged demand do NOT dirty a row — the steady-state refresh
+  // storm must not defeat delta uploads.
+  std::vector<uint8_t> dirty_flags;
+  std::vector<int32_t> dirty_list;
+  // One writer (tick thread) and many RPC-handler calls share the
+  // engine once the server moves prepare/apply off the event loop;
+  // every exported call locks. ctypes releases the GIL during calls,
+  // so a long pack blocks only callers touching this engine.
+  std::mutex mu;
 };
+
+inline void mark_dirty(Engine *e, int32_t rid) {
+  if (e->dirty_flags.size() < e->resources.size())
+    e->dirty_flags.resize(e->resources.size(), 0);
+  if (!e->dirty_flags[rid]) {
+    e->dirty_flags[rid] = 1;
+    e->dirty_list.push_back(rid);
+  }
+}
 
 // Shared upsert body (dm_assign and dm_bulk_assign): insert or replace
 // the client's lease, maintaining the running aggregates by delta.
 // Returns 1 if the client already held a lease, 0 if new.
-inline int32_t upsert(ResourceStore &r, int64_t cid, const Lease &fresh) {
+inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
+                      const Lease &fresh) {
+  ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) {
     r.index.emplace(cid, r.clients.size());
@@ -79,9 +109,15 @@ inline int32_t upsert(ResourceStore &r, int64_t cid, const Lease &fresh) {
     r.sum_has += fresh.has;
     r.sum_wants += fresh.wants;
     r.count += fresh.subclients;
+    ++r.version;
+    mark_dirty(e, rid);
     return 0;
   }
   Lease &l = r.leases[it->second];
+  if (l.has != fresh.has || l.wants != fresh.wants ||
+      l.subclients != fresh.subclients || l.priority != fresh.priority) {
+    mark_dirty(e, rid);
+  }
   r.sum_has += fresh.has - l.has;
   r.sum_wants += fresh.wants - l.wants;
   r.count += fresh.subclients - l.subclients;
@@ -99,16 +135,19 @@ void dm_engine_free(Engine *e) { delete e; }
 
 // Get-or-create the resource store for `id`; returns its handle.
 int32_t dm_resource(Engine *e, const char *id) {
+  std::lock_guard<std::mutex> lock(e->mu);
   auto it = e->resource_ids.find(id);
   if (it != e->resource_ids.end()) return it->second;
   const int32_t rid = static_cast<int32_t>(e->resources.size());
   e->resource_ids.emplace(id, rid);
   e->resources.emplace_back();
+  e->dirty_flags.push_back(0);
   return rid;
 }
 
 // Intern a client id; returns its handle (stable for the engine's life).
 int64_t dm_client(Engine *e, const char *id) {
+  std::lock_guard<std::mutex> lock(e->mu);
   auto it = e->client_ids.find(id);
   if (it != e->client_ids.end()) return it->second;
   const int64_t cid = e->next_client++;
@@ -121,39 +160,49 @@ int64_t dm_client(Engine *e, const char *id) {
 int32_t dm_assign(Engine *e, int32_t rid, int64_t cid, double expiry,
                   double refresh_interval, double has, double wants,
                   int32_t subclients, int64_t priority) {
-  return upsert(e->resources[rid], cid,
+  std::lock_guard<std::mutex> lock(e->mu);
+  return upsert(e, rid, cid,
                 Lease{expiry, refresh_interval, has, wants, subclients,
                       priority});
 }
 
 // Bulk upsert: one call assigns n leases (snapshot load / state
 // transfer; the per-call ctypes overhead of dm_assign dominates it for
-// large n). rid[i] are engine resource handles per edge. Returns n.
+// large n). rid[i] are engine resource handles per edge; out-of-range
+// handles are skipped. Returns the number assigned.
 int64_t dm_bulk_assign(Engine *e, const int32_t *rid, const int64_t *cid,
                        const double *expiry, const double *refresh,
                        const double *has, const double *wants,
                        const int32_t *subclients, const int64_t *priority,
                        int64_t n) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t assigned = 0;
+  const int32_t n_res = static_cast<int32_t>(e->resources.size());
   for (int64_t i = 0; i < n; ++i) {
-    upsert(e->resources[rid[i]], cid[i],
+    if (rid[i] < 0 || rid[i] >= n_res) continue;
+    upsert(e, rid[i], cid[i],
            Lease{expiry[i], refresh[i], has[i], wants[i], subclients[i],
                  priority[i]});
+    ++assigned;
   }
-  return n;
+  return assigned;
 }
 
 // Returns 1 if the client held a lease (now removed), else 0.
 int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
+  std::lock_guard<std::mutex> lock(e->mu);
   ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
   r.remove_slot(it->second);
+  mark_dirty(e, rid);
   return 1;
 }
 
 // Sweep leases with expiry < now (strict: `now > expiry` like the Python
 // store); returns how many were removed.
 int64_t dm_clean(Engine *e, int32_t rid, double now) {
+  std::lock_guard<std::mutex> lock(e->mu);
   ResourceStore &r = e->resources[rid];
   int64_t removed = 0;
   for (size_t slot = 0; slot < r.leases.size();) {
@@ -164,11 +213,191 @@ int64_t dm_clean(Engine *e, int32_t rid, double now) {
       ++slot;
     }
   }
+  if (removed) mark_dirty(e, rid);
   return removed;
+}
+
+// Engine-wide expiry sweep in one call; returns total removed.
+int64_t dm_clean_all(Engine *e, double now) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t removed = 0;
+  for (size_t rid = 0; rid < e->resources.size(); ++rid) {
+    ResourceStore &r = e->resources[rid];
+    int64_t here = 0;
+    for (size_t slot = 0; slot < r.leases.size();) {
+      if (now > r.leases[slot].expiry) {
+        r.remove_slot(slot);
+        ++here;
+      } else {
+        ++slot;
+      }
+    }
+    if (here) mark_dirty(e, static_cast<int32_t>(rid));
+    removed += here;
+  }
+  return removed;
+}
+
+// Drain the dirty-resource list: writes up to `cap` dirty handles to
+// `out`, clears the flags, returns the count written.
+int64_t dm_drain_dirty(Engine *e, int32_t *out, int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  const int64_t n =
+      std::min<int64_t>(cap, static_cast<int64_t>(e->dirty_list.size()));
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = e->dirty_list[i];
+    e->dirty_flags[e->dirty_list[i]] = 0;
+  }
+  e->dirty_list.erase(e->dirty_list.begin(), e->dirty_list.begin() + n);
+  return n;
+}
+
+// Dense row pack: for each of n resources, write its leases into row i
+// of the [n, K] slabs (slot-major, zero padding beyond the count).
+// counts_out[i] is the resource's FULL lease count (callers detect
+// K overflow when counts_out[i] > K); versions_out[i] its membership
+// epoch at pack time.
+void dm_pack_rows(Engine *e, const int32_t *rids, int64_t n, int64_t K,
+                  double *wants, double *has, double *sub, uint8_t *act,
+                  int32_t *counts_out, uint64_t *versions_out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    double *w = wants + i * K;
+    double *h = has + i * K;
+    double *s = sub + i * K;
+    uint8_t *a = act + i * K;
+    if (rids[i] < 0 ||
+        rids[i] >= static_cast<int32_t>(e->resources.size())) {
+      std::fill(w, w + K, 0.0);
+      std::fill(h, h + K, 0.0);
+      std::fill(s, s + K, 0.0);
+      std::fill(a, a + K, uint8_t{0});
+      counts_out[i] = 0;
+      versions_out[i] = 0;
+      continue;
+    }
+    const ResourceStore &r = e->resources[rids[i]];
+    const int64_t filled =
+        std::min<int64_t>(K, static_cast<int64_t>(r.leases.size()));
+    for (int64_t j = 0; j < filled; ++j) {
+      const Lease &l = r.leases[j];
+      w[j] = l.wants;
+      h[j] = l.has;
+      s[j] = l.subclients;
+      a[j] = 1;
+    }
+    std::fill(w + filled, w + K, 0.0);
+    std::fill(h + filled, h + K, 0.0);
+    std::fill(s + filled, s + K, 0.0);
+    std::fill(a + filled, a + K, uint8_t{0});
+    counts_out[i] = static_cast<int32_t>(r.leases.size());
+    versions_out[i] = r.version;
+  }
+}
+
+// Per-priority-band aggregates of one resource: writes up to `cap`
+// distinct (priority, wants-sum, subclient-count) triples in ascending
+// priority order; returns the number of bands. Feeds the intermediate
+// server's upstream aggregation without per-lease Python objects.
+int64_t dm_band_aggregates(Engine *e, int32_t rid, int64_t *prio_out,
+                           double *wants_out, int64_t *num_out,
+                           int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (rid < 0 || rid >= static_cast<int32_t>(e->resources.size()))
+    return 0;
+  const ResourceStore &r = e->resources[rid];
+  std::vector<std::pair<int64_t, std::pair<double, int64_t>>> bands;
+  for (const Lease &l : r.leases) {
+    bool found = false;
+    for (auto &b : bands) {
+      if (b.first == l.priority) {
+        b.second.first += l.wants;
+        b.second.second += l.subclients;
+        found = true;
+        break;
+      }
+    }
+    if (!found)
+      bands.push_back({l.priority, {l.wants, l.subclients}});
+  }
+  std::sort(bands.begin(), bands.end());
+  const int64_t n = std::min<int64_t>(
+      cap, static_cast<int64_t>(bands.size()));
+  for (int64_t i = 0; i < n; ++i) {
+    prio_out[i] = bands[i].first;
+    wants_out[i] = bands[i].second.first;
+    num_out[i] = bands[i].second.second;
+  }
+  return n;
+}
+
+// Bulk demand refresh: update wants and stamp expiry/refresh for n
+// leases, PRESERVING each lease's current has/subclients/priority —
+// the store effect of a client's periodic GetCapacity refresh. Missing
+// clients and out-of-range handles are skipped. Returns the number
+// refreshed.
+int64_t dm_bulk_refresh(Engine *e, const int32_t *rid, const int64_t *cid,
+                        const double *expiry, const double *refresh,
+                        const double *wants, int64_t n) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t refreshed = 0;
+  const int32_t n_res = static_cast<int32_t>(e->resources.size());
+  for (int64_t i = 0; i < n; ++i) {
+    if (rid[i] < 0 || rid[i] >= n_res) continue;
+    ResourceStore &r = e->resources[rid[i]];
+    auto it = r.index.find(cid[i]);
+    if (it == r.index.end()) continue;
+    Lease &l = r.leases[it->second];
+    if (l.wants != wants[i]) mark_dirty(e, rid[i]);
+    r.sum_wants += wants[i] - l.wants;
+    l.wants = wants[i];
+    l.expiry = expiry[i];
+    l.refresh_interval = refresh[i];
+    ++refreshed;
+  }
+  return refreshed;
+}
+
+// Dense grant write-back: grants is [n, K] row-major in the slot order
+// of each resource AT UPLOAD TIME. A row only applies when the
+// resource's membership epoch still equals expected_version[i] — rows
+// that changed while the solve was in flight are skipped (their change
+// dirtied the row, so the next tick re-solves and re-delivers them).
+// keep_has[i] != 0 stamps expiry/refresh but leaves has untouched
+// (learning-mode replay). Returns the number of rows applied.
+int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
+                       int64_t K, const double *grants,
+                       const double *expiry, const double *refresh,
+                       const uint8_t *keep_has,
+                       const uint64_t *expected_version) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t applied = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (rids[i] < 0 ||
+        rids[i] >= static_cast<int32_t>(e->resources.size()))
+      continue;
+    ResourceStore &r = e->resources[rids[i]];
+    if (r.version != expected_version[i]) continue;
+    const double *g = grants + i * K;
+    const int64_t filled =
+        std::min<int64_t>(K, static_cast<int64_t>(r.leases.size()));
+    for (int64_t j = 0; j < filled; ++j) {
+      Lease &l = r.leases[j];
+      if (!keep_has[i]) {
+        r.sum_has += g[j] - l.has;
+        l.has = g[j];
+      }
+      l.expiry = expiry[i];
+      l.refresh_interval = refresh[i];
+    }
+    ++applied;
+  }
+  return applied;
 }
 
 // out[0]=sum_has out[1]=sum_wants out[2]=subclient count out[3]=#leases
 void dm_sums(Engine *e, int32_t rid, double *out) {
+  std::lock_guard<std::mutex> lock(e->mu);
   const ResourceStore &r = e->resources[rid];
   out[0] = r.sum_has;
   out[1] = r.sum_wants;
@@ -179,6 +408,7 @@ void dm_sums(Engine *e, int32_t rid, double *out) {
 // Fetch one lease: out = {expiry, refresh_interval, has, wants,
 // subclients, priority}. Returns 1 if present, else 0 (out untouched).
 int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
+  std::lock_guard<std::mutex> lock(e->mu);
   const ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
@@ -197,6 +427,7 @@ int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
 int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
                 double *refresh, double *has, double *wants,
                 int32_t *subclients, int64_t *priority, int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
   const ResourceStore &r = e->resources[rid];
   const int64_t n =
       std::min<int64_t>(cap, static_cast<int64_t>(r.leases.size()));
@@ -214,6 +445,7 @@ int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
 }
 
 int64_t dm_total_leases(Engine *e) {
+  std::lock_guard<std::mutex> lock(e->mu);
   int64_t total = 0;
   for (const ResourceStore &r : e->resources)
     total += static_cast<int64_t>(r.leases.size());
@@ -228,6 +460,7 @@ int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
                 int32_t *ridx_out, int64_t *cid_out, double *wants_out,
                 double *has_out, double *sub_out, int64_t *prio_out,
                 int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
   int64_t w = 0;
   for (int32_t i = 0; i < n_order; ++i) {
     const ResourceStore &r = e->resources[order[i]];
@@ -261,6 +494,7 @@ int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
                  const double *gets, int64_t n_edges,
                  const double *expiry, const double *refresh,
                  const uint8_t *keep_has, uint8_t *applied_out) {
+  std::lock_guard<std::mutex> lock(e->mu);
   int64_t applied = 0;
   for (int64_t i = 0; i < n_edges; ++i) {
     applied_out[i] = 0;

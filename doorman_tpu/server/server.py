@@ -497,9 +497,9 @@ class CapacityServer(CapacityServicer):
                     # carries one aggregate has per resource).
                     bkey = _band_key(request.server_id, band.priority)
                     prev = res.store.get(bkey)
-                    if res.store.has_client(bkey) and (
-                        prev.expiry >= self._clock()
-                    ):
+                    # Missing bands return ZERO_LEASE (expiry 0), so one
+                    # expiry check covers both absent and lapsed.
+                    if prev.expiry >= self._clock():
                         has_band = prev.has
                     elif wants_total > 0:
                         has_band = has_total * (band.wants / wants_total)
@@ -643,13 +643,12 @@ class CapacityServer(CapacityServicer):
                 if res.parent_expiry is not None and res.capacity > 0:
                     rr.has.capacity = res.capacity
                     rr.has.expiry_time = int(res.parent_expiry)
-                bands: Dict[int, List[float]] = {}
-                for _client, lease in res.store.items():
-                    acc = bands.setdefault(lease.priority, [0.0, 0])
-                    acc[0] += lease.wants
-                    acc[1] += lease.subclients
-                for priority in sorted(bands):
-                    wants, num_clients = bands[priority]
+                # One aggregation call per resource (the native store
+                # does this in C — a 1M-lease store must not be walked
+                # per-lease on the event loop).
+                for priority, wants, num_clients in (
+                    res.store.band_aggregates()
+                ):
                     if wants <= 0:
                         continue
                     band = rr.wants.add()

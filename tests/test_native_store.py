@@ -255,3 +255,35 @@ resources:
         assert server.resources == {}
 
     asyncio.run(scenario())
+
+
+def test_band_aggregates_parity_and_bulk_refresh():
+    """band_aggregates: same triples from the Python and native stores;
+    bulk_refresh: wants update + stamp with has/priority preserved."""
+    import numpy as np
+
+    from doorman_tpu.core.store import LeaseStore
+
+    engine = native.StoreEngine()
+    ns = engine.store("r")
+    ps = LeaseStore("r")
+    for store in (ns, ps):
+        store.assign("a", 60, 5, 3.0, 10.0, 1, priority=2)
+        store.assign("b", 60, 5, 1.0, 5.0, 2, priority=1)
+        store.assign("c", 60, 5, 0.0, 7.0, 1, priority=2)
+    assert ns.band_aggregates() == ps.band_aggregates() == [
+        (1, 5.0, 2), (2, 17.0, 2),
+    ]
+
+    rids = np.full(2, ns._rid, np.int32)
+    cids = np.array(
+        [engine.client_handle("a"), engine.client_handle("zz")], np.int64
+    )
+    n = engine.bulk_refresh(
+        rids, cids, np.full(2, 1e12), np.full(2, 9.0), np.full(2, 42.0)
+    )
+    assert n == 1  # unknown client skipped
+    lease = ns.get("a")
+    assert lease.wants == 42.0 and lease.has == 3.0
+    assert lease.priority == 2 and lease.refresh_interval == 9.0
+    assert ns.sum_wants == 42.0 + 5.0 + 7.0
