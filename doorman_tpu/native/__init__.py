@@ -97,6 +97,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.dm_total_leases.restype = ctypes.c_int64
     lib.dm_total_leases.argtypes = [ctypes.c_void_p]
+    lib.dm_max_leases.restype = ctypes.c_int64
+    lib.dm_max_leases.argtypes = [ctypes.c_void_p]
     lib.dm_pack.restype = ctypes.c_int64
     lib.dm_pack.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P, _F64P,
@@ -213,6 +215,11 @@ class StoreEngine:
     @property
     def total_leases(self) -> int:
         return self._lib.dm_total_leases(self._ptr)
+
+    @property
+    def max_leases(self) -> int:
+        """Largest per-resource lease count (one O(R) C call)."""
+        return self._lib.dm_max_leases(self._ptr)
 
     def pack(self, order: List["NativeLeaseStore"]) -> Tuple[
         np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray,

@@ -306,20 +306,16 @@ int64_t dm_band_aggregates(Engine *e, int32_t rid, int64_t *prio_out,
   if (rid < 0 || rid >= static_cast<int32_t>(e->resources.size()))
     return 0;
   const ResourceStore &r = e->resources[rid];
-  std::vector<std::pair<int64_t, std::pair<double, int64_t>>> bands;
+  // O(L) accumulate + O(B log B) sort: this runs under the engine
+  // mutex for million-lease stores, so no per-lease band scan.
+  std::unordered_map<int64_t, std::pair<double, int64_t>> acc;
   for (const Lease &l : r.leases) {
-    bool found = false;
-    for (auto &b : bands) {
-      if (b.first == l.priority) {
-        b.second.first += l.wants;
-        b.second.second += l.subclients;
-        found = true;
-        break;
-      }
-    }
-    if (!found)
-      bands.push_back({l.priority, {l.wants, l.subclients}});
+    auto &slot = acc[l.priority];
+    slot.first += l.wants;
+    slot.second += l.subclients;
   }
+  std::vector<std::pair<int64_t, std::pair<double, int64_t>>> bands(
+      acc.begin(), acc.end());
   std::sort(bands.begin(), bands.end());
   const int64_t n = std::min<int64_t>(
       cap, static_cast<int64_t>(bands.size()));
@@ -442,6 +438,16 @@ int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
     priority[i] = l.priority;
   }
   return n;
+}
+
+// Largest per-resource lease count (the dense bucket width the
+// resident solver would need).
+int64_t dm_max_leases(Engine *e) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t m = 0;
+  for (const ResourceStore &r : e->resources)
+    m = std::max<int64_t>(m, static_cast<int64_t>(r.leases.size()));
+  return m;
 }
 
 int64_t dm_total_leases(Engine *e) {

@@ -27,7 +27,8 @@ from typing import Awaitable, Callable, Dict, List, Optional
 import grpc
 
 from doorman_tpu.algorithms import Request
-from doorman_tpu.core.resource import Resource
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.core.resource import Resource, algo_kind_for
 from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
 from doorman_tpu.server import config as config_mod
@@ -141,6 +142,17 @@ class CapacityServer(CapacityServicer):
         self._parent_conn = None  # created lazily (import cycle + testing)
         self._tasks: List[asyncio.Task] = []
         self._solver = None
+        # Device-resident tick path (native batch servers without
+        # priority-band resources): solver, its in-flight tick, and the
+        # cached eligibility decision.
+        self._resident = None
+        self._resident_handle = None
+        self._resident_ok_key = None
+        self._resident_ok = False
+        # Bumped whenever templates / learning windows / parent leases
+        # change outside the stores; the resident solver caches its
+        # config reads against it.
+        self._config_epoch = 0
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.port: Optional[int] = None
@@ -252,6 +264,7 @@ class CapacityServer(CapacityServicer):
             )
         first_time = self.config is None
         self.config = repo
+        self._config_epoch += 1
         self._push_groups()
         if first_time:
             self.is_configured.set()
@@ -288,6 +301,12 @@ class CapacityServer(CapacityServicer):
         self.resources = {}
         self._server_bands = {}
         self._reset_store_engine()
+        # The engine was replaced: the resident solver's device tables
+        # and any in-flight tick refer to the old one.
+        self._config_epoch += 1
+        self._resident = None
+        self._resident_handle = None
+        self._resident_ok_key = None
 
     async def _on_current_master(self, master: str) -> None:
         if master != self.current_master:
@@ -354,10 +373,72 @@ class CapacityServer(CapacityServicer):
                 {g.name: g.capacity for g in self.config.groups}
             )
 
+    def _resident_solver(self):
+        """The device-resident tick solver (lazily created); requires
+        the native engine."""
+        if self._resident is None:
+            import numpy as np
+
+            from doorman_tpu.solver.resident import ResidentDenseSolver
+
+            self._get_solver()  # settles x64 config for f64 mode
+            dtype = np.float64 if self.solver_dtype == "f64" else np.float32
+            engine = self._store_factory.__self__
+            self._resident = ResidentDenseSolver(
+                engine, dtype=dtype, clock=self._clock
+            )
+        return self._resident
+
+    def _resident_eligible(self, resources: List[Resource]) -> bool:
+        """The resident path covers native batch servers whose resources
+        are all lane algorithms; PRIORITY_BANDS (its own dense part,
+        group caps) takes the BatchSolver. Recomputed only when the
+        config epoch or the resource set moves."""
+        if not self._native_store:
+            return False
+        key = (self._config_epoch, len(resources))
+        if key != self._resident_ok_key:
+            from doorman_tpu.solver.batch import DENSE_MAX_K
+
+            self._resident_ok_key = key
+            engine = self._store_factory.__self__
+            self._resident_ok = engine.max_leases <= DENSE_MAX_K and all(
+                algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
+                for r in resources
+            )
+        return self._resident_ok
+
+    def _resident_step(self, resources: List[Resource]) -> None:
+        """One pipelined resident tick (runs in an executor thread; the
+        native engine is mutex-guarded against concurrent RPC writes):
+        collect the previous tick's grants, dispatch the next. Grants
+        land one tick after their solve — the same freshness as a
+        client's refresh cadence."""
+        solver = self._resident_solver()
+        handle, self._resident_handle = self._resident_handle, None
+        if handle is not None:
+            solver.collect(handle)
+        self._resident_handle = solver.dispatch(
+            resources, self._config_epoch
+        )
+
+    @property
+    def _ticks_done(self) -> int:
+        """Applied batch ticks across both tick paths (the serving
+        condition for store-backed grants)."""
+        ticks = self._solver.ticks if self._solver is not None else 0
+        if self._resident is not None:
+            ticks += self._resident.ticks
+        return ticks
+
     async def tick_once(self) -> None:
-        """Run one batched solve over all resources. Snapshot packing and
-        grant write-back run on the event loop (atomic w.r.t. RPC
-        handlers); only the device solve itself runs in the executor."""
+        """Run one batched solve over all resources.
+
+        Native stores: every phase runs in an executor thread (the C++
+        engine is mutex-guarded, so RPC handlers never wait on more
+        than one engine call). Python stores: snapshot packing and
+        write-back stay on the event loop (atomic w.r.t. handlers);
+        only the device solve leaves it."""
         if not self.resources:
             return
         solver = self._get_solver()
@@ -373,11 +454,39 @@ class CapacityServer(CapacityServicer):
                 log.exception("%s: profiler capture unavailable", self.id)
                 self._profile_done = True
         resources = list(self.resources.values())
-        snap = solver.prepare(resources)
         loop = asyncio.get_running_loop()
-        gets = await loop.run_in_executor(None, solver.solve, snap)
-        solver.apply(resources, snap, gets, return_grants=False)
-        if self._profiling and solver.ticks >= self.profile_ticks:
+
+        def run_tick():
+            snap = solver.prepare(resources)
+            gets = solver.solve(snap)
+            solver.apply(resources, snap, gets, return_grants=False)
+
+        if self._resident_eligible(resources):
+            from doorman_tpu.solver.resident import ResidentOverflow
+
+            def resident_or_fallback():
+                try:
+                    self._resident_step(resources)
+                except ResidentOverflow:
+                    # A resource outgrew the dense bucket mid-tick;
+                    # pin this server to the BatchSolver path until the
+                    # resource set or config moves again.
+                    log.warning(
+                        "%s: resident solver overflow; falling back to "
+                        "the batch path", self.id,
+                    )
+                    self._resident_ok = False
+                    self._resident_handle = None
+                    run_tick()
+
+            await loop.run_in_executor(None, resident_or_fallback)
+        elif self._native_store:
+            await loop.run_in_executor(None, run_tick)
+        else:
+            snap = solver.prepare(resources)
+            gets = await loop.run_in_executor(None, solver.solve, snap)
+            solver.apply(resources, snap, gets, return_grants=False)
+        if self._profiling and self._ticks_done >= self.profile_ticks:
             self._stop_profiler()
             log.info(
                 "%s: wrote a JAX profiler trace of %d ticks to %s",
@@ -605,8 +714,7 @@ class CapacityServer(CapacityServicer):
         if (
             self.mode == "batch"
             and not res.in_learning_mode
-            and self._solver is not None
-            and self._solver.ticks > 0
+            and self._ticks_done > 0
             and res.store.has_client(request.client)
         ):
             algo = res.template.algorithm

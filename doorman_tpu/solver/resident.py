@@ -47,6 +47,11 @@ from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
 
 
+class ResidentOverflow(RuntimeError):
+    """A resource outgrew the dense bucket cap; callers should fall back
+    to the BatchSolver path (its edge layout has no width limit)."""
+
+
 @dataclass
 class TickHandle:
     """One in-flight tick: the device output plus everything collect()
@@ -107,6 +112,7 @@ class ResidentDenseSolver:
         self._K = 8
         self._kfill = 8
         self._rot_cursor = 0
+        self._just_rebuilt = False
         self._uploaded_versions = np.zeros(0, np.uint64)
         self._rids = np.zeros(0, np.int32)
 
@@ -195,6 +201,12 @@ class ResidentDenseSolver:
         for i, r in enumerate(rows):
             self._rids[i] = r.store._rid
 
+        # Drain BEFORE packing: a store write landing between the pack
+        # and a drain would have its flag cleared without its data ever
+        # reaching the device. Post-drain writes re-flag and upload next
+        # tick; the pack below reads state at least as fresh as the
+        # drain point.
+        self._engine.drain_dirty()
         # One C call packs all rows; a second pass only if K was too
         # small for the widest resource.
         K = self._K
@@ -207,9 +219,9 @@ class ResidentDenseSolver:
                 break
             K = _bucket(kmax, 8)
         if kmax > DENSE_MAX_K:
-            raise RuntimeError(
+            raise ResidentOverflow(
                 f"resource with {kmax} clients exceeds the dense bucket "
-                f"cap {DENSE_MAX_K}; the resident path does not cover it"
+                f"cap {DENSE_MAX_K}"
             )
         self._K = K
         self._kfill = min(K, _bucket(max(kmax, 8), 8))
@@ -222,8 +234,8 @@ class ResidentDenseSolver:
         self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
         self._cap_raw = None
         self._refresh_config(rows, self._config_epoch, self._clock())
-        self._engine.drain_dirty()  # tables are fresh; clear stale flags
         self._rot_cursor = 0
+        self._just_rebuilt = True
         self._tick_fns.clear()
 
     def _rows_changed(self, resources: List[Resource]) -> bool:
@@ -333,13 +345,21 @@ class ResidentDenseSolver:
         self._uploaded_versions[dirty_rows] = versions
         self._refresh_config(res_list, config_epoch, now)
 
-        # Delivery set: every dirty row + the rotation slice.
-        rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
-        rot = (
-            self._rot_cursor + np.arange(rot_block, dtype=np.int64)
-        ) % max(self._R, 1)
-        self._rot_cursor = (self._rot_cursor + rot_block) % max(self._R, 1)
-        sel = np.unique(np.concatenate([dirty_rows, rot]))
+        # Delivery set: every dirty row + the rotation slice — or every
+        # row on a rebuild tick (the rebuild consumed the dirty set, so
+        # full delivery keeps same-tick freshness for whatever changed).
+        if self._just_rebuilt:
+            self._just_rebuilt = False
+            sel = np.arange(max(self._R, 1), dtype=np.int64)
+        else:
+            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
+            rot = (
+                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
+            ) % max(self._R, 1)
+            self._rot_cursor = (
+                self._rot_cursor + rot_block
+            ) % max(self._R, 1)
+            sel = np.unique(np.concatenate([dirty_rows, rot]))
         n_sel = len(sel)
 
         Db = _bucket(len(dirty_rows), 64)

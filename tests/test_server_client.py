@@ -322,6 +322,42 @@ def test_batch_mode_serves_solved_grants():
     run(body())
 
 
+def test_batch_mode_native_resident_serves_solved_grants():
+    """Native batch servers take the device-resident tick path: grants
+    land one tick after their solve (the pipelined collect), then serve
+    from the store like any batch grant."""
+
+    async def body():
+        from doorman_tpu import native
+
+        if not native.native_available():
+            pytest.skip("native engine unavailable")
+        server, addr = await make_server(mode="batch", native_store=True)
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                for c, w in [("a", 60.0), ("b", 60.0), ("c", 10.0)]:
+                    await stub.GetCapacity(
+                        capacity_request(c, "proportional", w)
+                    )
+                # dispatch -> collect+dispatch -> collect lands grants.
+                await server.tick_once()
+                await server.tick_once()
+                await server.tick_once()
+                assert server._resident is not None
+                assert server._resident.ticks >= 1
+                out = await stub.GetCapacity(
+                    capacity_request("b", "proportional", 60.0)
+                )
+                assert out.response[0].gets.capacity == pytest.approx(
+                    60.0 * 100.0 / 130.0
+                )
+        finally:
+            await server.stop()
+
+    run(body())
+
+
 def test_client_refresh_loop():
     async def body():
         server, addr = await make_server()
