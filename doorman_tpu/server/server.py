@@ -385,7 +385,10 @@ class CapacityServer(CapacityServicer):
             dtype = np.float64 if self.solver_dtype == "f64" else np.float32
             engine = self._store_factory.__self__
             self._resident = ResidentDenseSolver(
-                engine, dtype=dtype, clock=self._clock
+                engine, dtype=dtype, clock=self._clock,
+                # Grant delivery rides the config's fastest refresh
+                # cadence relative to this server's tick cadence.
+                rotate_ticks=None, tick_interval=self.tick_interval,
             )
         return self._resident
 

@@ -13,12 +13,19 @@ moves only what changed:
   solve:    the full table every tick (the device solve is cheap; `has`
             chains on device from the previous tick's grants);
   download: only the grant rows being DELIVERED this tick — every dirty
-            row (so demand changes land in the store within one tick)
+            row (so demand changes land in the store within one tick),
+            every row whose effective config changed (capacity cut,
+            parent-lease expiry, learning-mode flip: the reference
+            applies new config at the very next decide,
+            go/server/doorman/resource.go:117-140, so the store of
+            record must never serve grants computed under dead config),
             plus a rotating slice that covers the whole table every
-            `rotate_ticks` ticks (grants only need to reach the store as
-            often as clients refresh; the reference's own information
-            model is exactly this stale — client-reported `has` lags by
-            a refresh interval, go/server/doorman/server.go:732-817).
+            `rotate_ticks` ticks (steady-state grants only need to reach
+            the store as often as clients refresh; the reference's own
+            information model is exactly this stale — client-reported
+            `has` lags by a refresh interval,
+            go/server/doorman/server.go:732-817). `rotate_ticks` derives
+            from min(refresh_interval)/tick_interval unless pinned.
 
 Write-back safety: each row records the resource's membership epoch at
 upload; `dm_apply_dense` skips rows whose epoch moved while the solve
@@ -84,7 +91,8 @@ class ResidentDenseSolver:
         dtype=np.float32,
         device=None,
         clock: Callable[[], float] = time.time,
-        rotate_ticks: int = 8,
+        rotate_ticks: "int | None" = 8,
+        tick_interval: "float | None" = None,
         download_dtype=None,
     ):
         import jax
@@ -97,7 +105,18 @@ class ResidentDenseSolver:
         self._dtype = np.dtype(dtype)
         self._device = device
         self._clock = clock
-        self.rotate_ticks = max(int(rotate_ticks), 1)
+        # rotate_ticks=None derives the rotation from the config each
+        # time templates are read: delivery rides the fastest refresh
+        # cadence (min refresh_interval / tick_interval), which is the
+        # staleness the reference's own information model already has —
+        # client-reported state lags by one refresh interval. An explicit
+        # int pins it (bench tuning).
+        self._tick_interval = tick_interval
+        self._rotate_override: "int | None" = None
+        if rotate_ticks is None:
+            self._rotate = 8
+        else:
+            self.rotate_ticks = rotate_ticks
         # Grants download in the solve dtype by default: bf16 would halve
         # the bytes but its ~0.4% rounding can push sum(has) over
         # capacity in the store; correctness wins by default.
@@ -128,6 +147,15 @@ class ResidentDenseSolver:
         self._tick_fns: Dict[Tuple[int, int, int], Callable] = {}
 
     # -- configuration ------------------------------------------------
+
+    @property
+    def rotate_ticks(self) -> int:
+        return self._rotate
+
+    @rotate_ticks.setter
+    def rotate_ticks(self, value: int) -> None:
+        self._rotate_override = max(int(value), 1)
+        self._rotate = self._rotate_override
 
     def _put(self, arr):
         import jax
@@ -161,6 +189,14 @@ class ResidentDenseSolver:
         self._learn_end = learn_end
         self._parent_exp = parent_exp
         self._lease_len, self._refresh = lease_len, refresh
+        if self._rotate_override is None and self._tick_interval and rows:
+            # Delivery must cover the whole table at least once per
+            # refresh interval, else a client can refresh against a
+            # store row older than its own cadence.
+            self._rotate = max(
+                1,
+                int(refresh[: len(rows)].min() / self._tick_interval),
+            )
         if self._kind_h is None or not np.array_equal(kind, self._kind_h):
             self._kind_h, self._kind_d = kind, self._put(kind)
         if self._statc_h is None or not np.array_equal(statc, self._statc_h):
@@ -168,11 +204,21 @@ class ResidentDenseSolver:
 
     def _refresh_config(
         self, rows: Sequence[Resource], config_epoch: int, now: float
-    ) -> None:
+    ) -> "np.ndarray | None":
         """Per-tick config view: templates re-read only when the epoch
         moved; time-driven drift (learning-mode end, parent-lease
-        expiry) recomputed vectorized every tick."""
-        if config_epoch != self._config_epoch or self._cap_raw is None:
+        expiry) recomputed vectorized every tick.
+
+        Returns the rows whose effective config changed this tick (they
+        must be DELIVERED this tick — the solve sees new config
+        immediately, and the store of record must too, matching the
+        reference's config-at-next-decide semantics,
+        go/server/doorman/resource.go:117-140). None means "everything
+        may have changed" (epoch moved / first tick): deliver all."""
+        epoch_moved = (
+            config_epoch != self._config_epoch or self._cap_raw is None
+        )
+        if epoch_moved:
             self._config_epoch = config_epoch
             self._read_config(rows)
         # Expired parent lease => capacity 0 (core/resource.py:capacity).
@@ -180,10 +226,16 @@ class ResidentDenseSolver:
             self._parent_exp < now, 0.0, self._cap_raw
         ).astype(self._dtype)
         learn = self._learn_end > now
+        if epoch_moved or self._cap_h is None or self._learn_h is None:
+            changed: "np.ndarray | None" = None
+        else:
+            mask = (cap != self._cap_h) | (learn != self._learn_h)
+            changed = np.nonzero(mask)[0]
         if self._cap_h is None or not np.array_equal(cap, self._cap_h):
             self._cap_h, self._cap_d = cap, self._put(cap)
         if self._learn_h is None or not np.array_equal(learn, self._learn_h):
             self._learn_h, self._learn_d = learn, self._put(learn)
+        return changed
 
     # -- build / rebuild ----------------------------------------------
 
@@ -343,12 +395,16 @@ class ResidentDenseSolver:
         elif kmax > self._kfill:
             self._kfill = min(self._K, _bucket(kmax, 8))
         self._uploaded_versions[dirty_rows] = versions
-        self._refresh_config(res_list, config_epoch, now)
+        config_changed = self._refresh_config(res_list, config_epoch, now)
 
-        # Delivery set: every dirty row + the rotation slice — or every
-        # row on a rebuild tick (the rebuild consumed the dirty set, so
-        # full delivery keeps same-tick freshness for whatever changed).
-        if self._just_rebuilt:
+        # Delivery set: every dirty row + every config-changed row + the
+        # rotation slice — or every row on a rebuild/epoch-moved tick
+        # (the rebuild consumed the dirty set, and an epoch move can
+        # change any row's grant, so full delivery keeps same-tick
+        # freshness for whatever changed; reference semantics: new
+        # config applies at the very next decide,
+        # go/server/doorman/resource.go:117-140).
+        if self._just_rebuilt or config_changed is None:
             self._just_rebuilt = False
             sel = np.arange(max(self._R, 1), dtype=np.int64)
         else:
@@ -359,7 +415,11 @@ class ResidentDenseSolver:
             self._rot_cursor = (
                 self._rot_cursor + rot_block
             ) % max(self._R, 1)
-            sel = np.unique(np.concatenate([dirty_rows, rot]))
+            parts = [dirty_rows, rot]
+            if len(config_changed):
+                # Config rows at/above _R are padding; never deliver them.
+                parts.append(config_changed[config_changed < self._R])
+            sel = np.unique(np.concatenate(parts))
         n_sel = len(sel)
 
         Db = _bucket(len(dirty_rows), 64)
