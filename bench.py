@@ -370,14 +370,23 @@ def bench_server_tick() -> None:
         )
 
     tick_ms = []
+    churn_ms = []
     handles = []
+    phase_mark = {}
+    collects_mark = 0
     for t in range(n_ticks):
+        if t == SERVER_WARMUP:
+            phase_mark = dict(solver.phase_s)
+            collects_mark = solver.ticks
         t0 = time.perf_counter()
         churn(t)
+        t1 = time.perf_counter()
         handles.append(solver.dispatch(resources))
         if len(handles) >= PIPELINE_DEPTH_SERVER:
             solver.collect(handles.pop(0))
-        tick_ms.append((time.perf_counter() - t0) * 1000.0)
+        t2 = time.perf_counter()
+        churn_ms.append((t1 - t0) * 1000.0)
+        tick_ms.append((t2 - t0) * 1000.0)
     t0 = time.perf_counter()
     for h in handles:
         solver.collect(h)
@@ -386,6 +395,26 @@ def bench_server_tick() -> None:
         t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
     )
     med = float(np.median(timed))
+    # Per-phase attribution over the measured window (ms per tick):
+    # dispatch = sweep + drain + pack + config + upload + launch;
+    # collect = download + apply; churn is the client-write workload
+    # applied between ticks (included in the headline number because
+    # the reference's per-request decide pays it inline too). Collect
+    # phases divide by the collects actually in the window (pipelining
+    # shifts a few warmup collects past the snapshot).
+    n_collects = max(solver.ticks - collects_mark, 1)
+    collect_phases = ("download", "apply")
+    phases = {
+        k: round(
+            (v - phase_mark.get(k, 0.0)) * 1000.0
+            / (n_collects if k in collect_phases else TICKS_SERVER),
+            3,
+        )
+        for k, v in solver.phase_s.items()
+    }
+    phases["churn"] = round(
+        float(np.mean(churn_ms[SERVER_WARMUP:])), 3
+    )
     print(
         json.dumps(
             {
@@ -398,6 +427,7 @@ def bench_server_tick() -> None:
                 "p90_ms": round(float(np.percentile(timed, 90)), 3),
                 "pipeline_depth": PIPELINE_DEPTH_SERVER,
                 "rotate_ticks": SERVER_ROTATE_TICKS,
+                "phase_ms": phases,
             }
         )
     )

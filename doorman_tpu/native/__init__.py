@@ -116,6 +116,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_clean_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
     lib.dm_drain_dirty.restype = ctypes.c_int64
     lib.dm_drain_dirty.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int64]
+    lib.dm_drain_dirty2.restype = ctypes.c_int64
+    lib.dm_drain_dirty2.argtypes = [ctypes.c_void_p, _I32P, u8p,
+                                    ctypes.c_int64]
     lib.dm_pack_rows.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int64, ctypes.c_int64,
         _F64P, _F64P, _F64P, u8p, _I32P, u64p,
@@ -328,6 +331,30 @@ class StoreEngine:
             if n < len(buf):
                 break
         return np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+    def drain_dirty2(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Like drain_dirty, plus a parallel uint8 array flagging rows
+        that changed beyond wants (membership / has / subclients /
+        priority) — those need a full re-upload; unflagged rows changed
+        only in wants and ship just the wants lane."""
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        rid_chunks, full_chunks = [], []
+        while True:
+            buf = np.empty(4096, np.int32)
+            full = np.empty(4096, np.uint8)
+            n = int(
+                self._lib.dm_drain_dirty2(
+                    self._ptr, buf.ctypes.data_as(_I32P),
+                    full.ctypes.data_as(u8p), len(buf)
+                )
+            )
+            rid_chunks.append(buf[:n])
+            full_chunks.append(full[:n])
+            if n < len(buf):
+                break
+        if len(rid_chunks) > 1:
+            return np.concatenate(rid_chunks), np.concatenate(full_chunks)
+        return rid_chunks[0], full_chunks[0]
 
     def pack_rows(self, rids: np.ndarray, K: int):
         """Dense [n, K] row pack of the given resources: returns

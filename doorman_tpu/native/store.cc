@@ -48,6 +48,11 @@ struct ResourceStore {
   // the epoch it uploaded and skips write-backs whose rows went stale
   // while the solve was in flight.
   uint64_t version = 0;
+  // Set when the row changed beyond wants (membership, has, subclients,
+  // priority) since the last drain2: such rows need a full re-upload,
+  // while wants-only churn — the steady-state refresh traffic — ships
+  // just the wants lane over the (slow) host<->device link.
+  uint8_t dirty_full = 0;
 
   void remove_slot(size_t slot) {
     const Lease &l = leases[slot];
@@ -64,6 +69,7 @@ struct ResourceStore {
     clients.pop_back();
     leases.pop_back();
     ++version;
+    dirty_full = 1;
   }
 };
 
@@ -110,12 +116,16 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
     r.sum_wants += fresh.wants;
     r.count += fresh.subclients;
     ++r.version;
+    r.dirty_full = 1;
     mark_dirty(e, rid);
     return 0;
   }
   Lease &l = r.leases[it->second];
-  if (l.has != fresh.has || l.wants != fresh.wants ||
-      l.subclients != fresh.subclients || l.priority != fresh.priority) {
+  const bool full_changed = l.has != fresh.has ||
+                            l.subclients != fresh.subclients ||
+                            l.priority != fresh.priority;
+  if (full_changed) r.dirty_full = 1;
+  if (full_changed || l.wants != fresh.wants) {
     mark_dirty(e, rid);
   }
   r.sum_has += fresh.has - l.has;
@@ -247,6 +257,25 @@ int64_t dm_drain_dirty(Engine *e, int32_t *out, int64_t cap) {
   for (int64_t i = 0; i < n; ++i) {
     out[i] = e->dirty_list[i];
     e->dirty_flags[e->dirty_list[i]] = 0;
+  }
+  e->dirty_list.erase(e->dirty_list.begin(), e->dirty_list.begin() + n);
+  return n;
+}
+
+// Like dm_drain_dirty, but also reports (and clears) each drained
+// resource's dirty_full flag: full_out[i]=1 means the row changed
+// beyond wants since its last drain and needs a full re-upload.
+int64_t dm_drain_dirty2(Engine *e, int32_t *out, uint8_t *full_out,
+                        int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  const int64_t n =
+      std::min<int64_t>(cap, static_cast<int64_t>(e->dirty_list.size()));
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t rid = e->dirty_list[i];
+    out[i] = rid;
+    e->dirty_flags[rid] = 0;
+    full_out[i] = e->resources[rid].dirty_full;
+    e->resources[rid].dirty_full = 0;
   }
   e->dirty_list.erase(e->dirty_list.begin(), e->dirty_list.begin() + n);
   return n;

@@ -54,6 +54,16 @@ from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
 
 
+def _ceil_to(n: int, m: int) -> int:
+    """Round up to a multiple of m (>= m). Per-tick scatter/delivery
+    shapes use multiples, not powers of two: the host<->device link is
+    the tick's bottleneck, and a power-of-two bucket ships up to 2x the
+    bytes for the same work (2048x128 vs 1280x104 is half a megabyte per
+    tick at the bench shape). Multiples keep the recompile count bounded
+    (shapes per axis <= axis_max / m) while tracking the true size."""
+    return max(m, ((n + m - 1) // m) * m)
+
+
 class ResidentOverflow(RuntimeError):
     """A resource outgrew the dense bucket cap; callers should fall back
     to the BatchSolver path (its edge layout has no width limit)."""
@@ -123,9 +133,13 @@ class ResidentDenseSolver:
         self._out_dtype = download_dtype or self._dtype
         self.ticks = 0
         self.last_tick_seconds = 0.0
+        # Per-phase wall-time accumulators (seconds) for the perf
+        # breakdown; bench.py reports them per tick. Keys: sweep, drain,
+        # pack, config, upload, launch, download, apply.
+        self.phase_s: Dict[str, float] = {}
 
         self._rows: List[Resource] = []
-        self._row_of_rid: Dict[int, int] = {}
+        self._row_lut = np.full(1, -1, np.int64)
         self._R = 0  # real rows
         self._Rp = 0  # padded rows
         self._K = 8
@@ -244,8 +258,14 @@ class ResidentDenseSolver:
         whenever the resource set, bucket width, or config shape moves."""
         rows = list(resources)
         self._rows = rows
-        self._row_of_rid = {r.store._rid: i for i, r in enumerate(rows)}
         self._R = len(rows)
+        # Vectorized rid -> row mapping (one fancy-index per tick); the
+        # trailing extra slot is -1 so clamped out-of-range rids (other
+        # resources sharing the engine) resolve to "not ours".
+        max_rid = max((r.store._rid for r in rows), default=-1)
+        self._row_lut = np.full(max_rid + 2, -1, np.int64)
+        for i, r in enumerate(rows):
+            self._row_lut[r.store._rid] = i
         # +1 reserves a padding row: ticks with no dirty rows scatter a
         # zero row there instead of disturbing a live row's has chain.
         self._Rp = _round_rows(self._R + 1)
@@ -257,8 +277,8 @@ class ResidentDenseSolver:
         # and a drain would have its flag cleared without its data ever
         # reaching the device. Post-drain writes re-flag and upload next
         # tick; the pack below reads state at least as fresh as the
-        # drain point.
-        self._engine.drain_dirty()
+        # drain point. drain2 so dirty_full flags reset with the drain.
+        self._engine.drain_dirty2()
         # One C call packs all rows; a second pass only if K was too
         # small for the widest resource.
         K = self._K
@@ -276,7 +296,7 @@ class ResidentDenseSolver:
                 f"cap {DENSE_MAX_K}"
             )
         self._K = K
-        self._kfill = min(K, _bucket(max(kmax, 8), 8))
+        self._kfill = min(K, _ceil_to(kmax, 8))
         dtype = self._dtype
         self._wants = self._put(w.astype(dtype))
         self._has = self._put(h.astype(dtype))
@@ -300,14 +320,13 @@ class ResidentDenseSolver:
 
     # -- the tick executable ------------------------------------------
 
-    def _tick_fn(self, Db: int, Sb: int):
-        key = (Db, Sb, self._kfill)
+    def _tick_fn(self, Da: int, Df: int, Sb: int):
+        key = (Da, Df, Sb, self._kfill)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
 
         import jax
-        import jax.numpy as jnp
         from functools import partial
 
         from doorman_tpu.solver.batch import _committed_platform
@@ -326,13 +345,24 @@ class ResidentDenseSolver:
         kfill = self._kfill
         out_dtype = self._out_dtype
 
+        # Scatters touch only the first `kfill` lanes: the table is
+        # zeroed beyond every row's count at rebuild and `kfill` never
+        # shrinks between rebuilds, so lanes >= kfill stay inactive.
+        # Wants-only rows (`a_*`, the steady-state churn) ship just the
+        # wants lane; rows whose shape changed (`f_*`: membership, has,
+        # subclients) ship everything. One fused int32 index upload
+        # carries all three index sets — the tunnel link charges per
+        # transfer op, not just per byte.
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def tick(wants, has, sub, act, d_idx, d_w, d_h, d_s, d_a,
-                 cap, kind, learn, statc, sel_idx):
-            wants = wants.at[d_idx].set(d_w)
-            has = has.at[d_idx].set(d_h)
-            sub = sub.at[d_idx].set(d_s)
-            act = act.at[d_idx].set(d_a)
+        def tick(wants, has, sub, act, idx, a_w, f_block, f_act,
+                 cap, kind, learn, statc):
+            a_idx = idx[:Da]
+            f_idx = idx[Da:Da + Df]
+            sel_idx = idx[Da + Df:]
+            wants = wants.at[a_idx, :kfill].set(a_w)
+            has = has.at[f_idx, :kfill].set(f_block[0])
+            sub = sub.at[f_idx, :kfill].set(f_block[1])
+            act = act.at[f_idx, :kfill].set(f_act)
             gets = solve(
                 DenseBatch(
                     wants=wants, has=has, subclients=sub, active=act,
@@ -361,41 +391,70 @@ class ResidentDenseSolver:
         `config_epoch`: bump whenever templates / learning windows /
         parent leases changed outside the store (config reload,
         mastership change) — template reads are cached against it."""
+        t0 = time.perf_counter()
+        ph = self.phase_s
+
+        def lap(name):
+            nonlocal t0
+            t1 = time.perf_counter()
+            ph[name] = ph.get(name, 0.0) + (t1 - t0)
+            t0 = t1
+
         now = self._clock()
         self._engine.clean_all(now)
+        lap("sweep")
         res_list = list(resources)
         if self._wants is None or self._rows_changed(res_list):
             self.rebuild(res_list)
+            t0 = time.perf_counter()  # rebuilds are rare; keep laps clean
 
-        dirty_rids = self._engine.drain_dirty()
-        dirty_rows = np.asarray(
-            [
-                self._row_of_rid[int(rid)]
-                for rid in dirty_rids
-                if int(rid) in self._row_of_rid
-            ],
-            np.int64,
-        )
+        dirty_rids, full_flags = self._engine.drain_dirty2()
+        if len(dirty_rids):
+            lut = self._row_lut
+            rows_all = lut[np.minimum(dirty_rids, len(lut) - 1)]
+            valid = rows_all >= 0
+            dirty_rows = rows_all[valid]
+            dirty_full = full_flags[valid].astype(bool)
+        else:
+            dirty_rows = np.zeros(0, np.int64)
+            dirty_full = np.zeros(0, bool)
+        lap("drain")
         if len(dirty_rows) == 0:
             # No demand changes: scatter the reserved zero padding row.
             dirty_rows = np.asarray([self._R], np.int64)
-        pack_rids = self._rids[dirty_rows]
-        w, h, s, act, counts, versions = self._engine.pack_rows(
-            pack_rids, self._K
+            dirty_full = np.asarray([False])
+        # Full-upload rows first, wants-only rows after; one C pack call
+        # at the fill width (no padding lanes cross the host link).
+        order = np.concatenate(
+            [dirty_rows[dirty_full], dirty_rows[~dirty_full]]
         )
-        kmax = int(counts.max()) if len(counts) else 0
-        if kmax > self._K:
-            # Bucket overflow: a resource outgrew the lane width.
-            self.rebuild(res_list)
-            dirty_rows = np.asarray([self._R], np.int64)
-            pack_rids = self._rids[dirty_rows]
+        n_full = int(dirty_full.sum())
+        pack_rids = self._rids[order]
+        while True:
             w, h, s, act, counts, versions = self._engine.pack_rows(
-                pack_rids, self._K
+                pack_rids, self._kfill
             )
-        elif kmax > self._kfill:
-            self._kfill = min(self._K, _bucket(kmax, 8))
-        self._uploaded_versions[dirty_rows] = versions
+            kmax = int(counts.max()) if len(counts) else 0
+            if kmax <= self._kfill:
+                break
+            if _ceil_to(kmax, 8) > self._K:
+                # Bucket overflow: a resource outgrew the lane width.
+                self.rebuild(res_list)
+                order = np.asarray([self._R], np.int64)
+                n_full = 0
+                pack_rids = self._rids[order]
+            else:
+                self._kfill = min(self._K, _ceil_to(kmax, 8))
+        # Rows whose membership epoch moved between the drain and the
+        # pack are promoted to full uploads: their packed slot order no
+        # longer matches the device tables' act/sub/has lanes.
+        is_full = np.zeros(len(order), bool)
+        is_full[:n_full] = True
+        is_full |= versions != self._uploaded_versions[order]
+        self._uploaded_versions[order] = versions
+        lap("pack")
         config_changed = self._refresh_config(res_list, config_epoch, now)
+        lap("config")
 
         # Delivery set: every dirty row + every config-changed row + the
         # rotation slice — or every row on a rebuild/epoch-moved tick
@@ -415,36 +474,55 @@ class ResidentDenseSolver:
             self._rot_cursor = (
                 self._rot_cursor + rot_block
             ) % max(self._R, 1)
-            parts = [dirty_rows, rot]
+            parts = [order, rot]
             if len(config_changed):
                 # Config rows at/above _R are padding; never deliver them.
                 parts.append(config_changed[config_changed < self._R])
             sel = np.unique(np.concatenate(parts))
         n_sel = len(sel)
 
-        Db = _bucket(len(dirty_rows), 64)
-        Sb = _bucket(n_sel, 256)
-        d_idx = np.resize(dirty_rows, Db)
-        pad = np.resize(np.arange(len(dirty_rows)), Db)
+        kfill = self._kfill
         dtype = self._dtype
+        Da = _ceil_to(len(order), 64)
+        Df = _ceil_to(int(is_full.sum()), 8)
+        Sb = _ceil_to(n_sel, 256)
+        a_pad = np.resize(np.arange(len(order)), Da)
+        a_idx = order[a_pad]
+        a_w = np.ascontiguousarray(w[a_pad, :kfill]).astype(dtype)
+        f_pos = np.nonzero(is_full)[0]
+        if len(f_pos):
+            f_pad = np.resize(f_pos, Df)
+            f_idx = order[f_pad]
+            f_block = np.stack(
+                [h[f_pad, :kfill], s[f_pad, :kfill]]
+            ).astype(dtype)
+            f_act = np.ascontiguousarray(act[f_pad, :kfill]).astype(bool)
+        else:
+            # Nothing full-dirty: aim the shape-lane scatter at the
+            # reserved padding row with zero data.
+            f_idx = np.full(Df, self._R, np.int64)
+            f_block = np.zeros((2, Df, kfill), dtype)
+            f_act = np.zeros((Df, kfill), bool)
         sel_pad = np.resize(sel, Sb)
+        idx_host = np.concatenate([a_idx, f_idx, sel_pad]).astype(np.int32)
 
         put = self._put
-        tick = self._tick_fn(Db, Sb)
+        tick = self._tick_fn(Da, Df, Sb)
+        staged = (put(idx_host), put(a_w), put(f_block), put(f_act))
+        lap("upload")
+        idx_d, a_w_d, f_block_d, f_act_d = staged
         (
             self._wants, self._has, self._sub, self._act, out
         ) = tick(
             self._wants, self._has, self._sub, self._act,
-            put(d_idx), put(w[pad].astype(dtype)),
-            put(h[pad].astype(dtype)), put(s[pad].astype(dtype)),
-            put(act[pad].astype(bool)),
+            idx_d, a_w_d, f_block_d, f_act_d,
             self._cap_d, self._kind_d, self._learn_d, self._statc_d,
-            put(sel_pad),
         )
         try:
             out.copy_to_host_async()
         except Exception:
             pass
+        lap("launch")
         return TickHandle(
             out=out,
             sel_rows=sel,
@@ -468,8 +546,13 @@ class ResidentDenseSolver:
         if handle.collected:
             return 0
         handle.collected = True
+        t0 = time.perf_counter()
         gets = chunked_device_get(handle.out)
         gets = np.asarray(gets, np.float64)[: handle.n_sel]
+        t1 = time.perf_counter()
+        self.phase_s["download"] = (
+            self.phase_s.get("download", 0.0) + (t1 - t0)
+        )
         applied = self._engine.apply_dense(
             handle.rids,
             gets,
@@ -477,6 +560,9 @@ class ResidentDenseSolver:
             handle.refresh,
             handle.keep_has,
             handle.versions,
+        )
+        self.phase_s["apply"] = (
+            self.phase_s.get("apply", 0.0) + (time.perf_counter() - t1)
         )
         self.ticks += 1
         self.last_tick_seconds = self._clock() - handle.dispatched_at

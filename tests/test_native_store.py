@@ -287,3 +287,50 @@ def test_band_aggregates_parity_and_bulk_refresh():
     assert lease.wants == 42.0 and lease.has == 3.0
     assert lease.priority == 2 and lease.refresh_interval == 9.0
     assert ns.sum_wants == 42.0 + 5.0 + 7.0
+
+
+def test_drain_dirty2_classifies_wants_only_vs_full():
+    """drain_dirty2 flags rows that changed beyond wants: membership,
+    has, or subclients set dirty_full; pure wants churn (bulk_refresh,
+    or assign with only wants moved) does not."""
+    import numpy as np
+
+    engine = native.StoreEngine()
+    sa = engine.store("a")
+    sb = engine.store("b")
+    sc = engine.store("c")
+    sa.assign("x", 60, 5, 0.0, 10.0, 1)
+    sb.assign("y", 60, 5, 0.0, 10.0, 1)
+    sc.assign("z", 60, 5, 0.0, 10.0, 1)
+    rids, full = engine.drain_dirty2()
+    assert set(rids) == {sa._rid, sb._rid, sc._rid}
+    assert all(full)  # inserts are membership changes
+
+    # wants-only churn: assign same has/sub, new wants -> not full.
+    sa.assign("x", 60, 5, 0.0, 20.0, 1)
+    # has change -> full (learning-mode echo must reach the device).
+    sb.assign("y", 60, 5, 4.0, 10.0, 1)
+    # bulk wants refresh -> not full.
+    engine.bulk_refresh(
+        np.asarray([sc._rid], np.int32),
+        np.asarray([engine.client_handle("z")], np.int64),
+        np.full(1, 1e12), np.full(1, 5.0), np.full(1, 30.0),
+    )
+    rids, full = engine.drain_dirty2()
+    flags = dict(zip(rids.tolist(), full.tolist()))
+    assert flags[sa._rid] == 0
+    assert flags[sb._rid] == 1
+    assert flags[sc._rid] == 0
+
+    # release -> membership change -> full; subclient change -> full.
+    sa.release("x")
+    sb.assign("y", 60, 5, 4.0, 10.0, 3)
+    rids, full = engine.drain_dirty2()
+    flags = dict(zip(rids.tolist(), full.tolist()))
+    assert flags[sa._rid] == 1 and flags[sb._rid] == 1
+
+    # The flag is consumed by the drain: re-dirtying with wants only
+    # afterwards reports not-full again.
+    sb.assign("y", 60, 5, 4.0, 11.0, 3)
+    rids, full = engine.drain_dirty2()
+    assert dict(zip(rids.tolist(), full.tolist()))[sb._rid] == 0
