@@ -9,21 +9,20 @@ the identity of the current master. Two implementations:
   * KVElection — the reference's etcd flow (TTL'd lock key: acquire with
     set-if-absent, renew every ttl/3, watch broadcasts the holder)
     generalized over an abstract LeaseKV so the failover state machine is
-    testable without an etcd cluster. EtcdKV speaks the etcd v2 HTTP API
-    when an etcd endpoint is actually available; InMemoryKV backs tests and
-    multi-server single-process setups.
+    testable without an etcd cluster. EtcdKV speaks the etcd v3 gateway
+    (shared client server/etcd.py — the same API generation the config
+    source uses) when an etcd endpoint is actually available; InMemoryKV
+    backs tests and multi-server single-process setups.
 """
 
 from __future__ import annotations
 
 import abc
 import asyncio
-import json
 import time
-import urllib.error
-import urllib.parse
-import urllib.request
-from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from doorman_tpu.server.etcd import EtcdGateway
 
 IsMasterCallback = Callable[[bool], Awaitable[None]]
 CurrentMasterCallback = Callable[[str], Awaitable[None]]
@@ -114,63 +113,87 @@ class InMemoryKV(LeaseKV):
 
 
 class EtcdKV(LeaseKV):
-    """etcd v2 HTTP API LeaseKV (reference election.go:112-171 uses the v2
-    client). Blocking HTTP is pushed to the default executor; this is a
-    control-plane path where latency tolerance is seconds."""
+    """etcd v3 LeaseKV over the shared gateway client (server/etcd.py).
+
+    The reference election used its era's v2 client with a TTL'd key
+    (election.go:112-171); the v3 idiom for the same lock is a lease:
+    acquire = lease grant + transactional create (put iff the key does
+    not exist), refresh = lease keepalive (the key dies with the lease),
+    get = range read. Blocking HTTP runs in the default executor with a
+    short per-request timeout: renewal failure must be observed well
+    inside the lock TTL, or a partitioned master keeps acting as master
+    after a standby wins (the v2 client's 5s timeout had the same
+    role)."""
+
+    # Mastership-loss detection must fit inside KVElection's renewal
+    # cadence (ttl/3 with ttl defaulting to 10s), not the gateway's
+    # lenient config-watch default.
+    REQUEST_TIMEOUT = 5.0
 
     def __init__(self, endpoints: list[str]):
-        if not endpoints:
-            raise ValueError("EtcdKV needs at least one endpoint")
-        self._endpoints = [e.rstrip("/") for e in endpoints]
+        self._gw = EtcdGateway(endpoints)
+        self._leases: Dict[str, int] = {}  # lock key -> held lease id
 
-    async def _request(
-        self, method: str, key: str, params: Optional[dict] = None
-    ) -> Optional[dict]:
-        def call() -> Optional[dict]:
-            for endpoint in self._endpoints:
-                url = f"{endpoint}/v2/keys{key}"
-                data = None
-                if params is not None:
-                    data = urllib.parse.urlencode(params).encode()
-                req = urllib.request.Request(url, data=data, method=method)
-                try:
-                    with urllib.request.urlopen(req, timeout=5) as resp:
-                        return json.load(resp)
-                except urllib.error.HTTPError as e:
-                    try:
-                        return json.load(e)
-                    except Exception:
-                        return None
-                except OSError:
-                    continue
+    async def _call(self, fn):
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn
+            )
+        except Exception:
             return None
-
-        return await asyncio.get_running_loop().run_in_executor(None, call)
 
     async def acquire(self, key, value, ttl) -> bool:
-        out = await self._request(
-            "PUT", key,
-            {"value": value, "ttl": int(ttl), "prevExist": "false"},
-        )
-        return bool(out) and "errorCode" not in out
+        t = self.REQUEST_TIMEOUT
+
+        def attempt() -> Optional[int]:
+            # Cheap existence probe first: the standby's campaign loop
+            # runs for the deployment's lifetime and the lock is almost
+            # always held — don't churn lease grants on every cycle.
+            if self._gw.get(key, timeout=t) is not None:
+                return None
+            lease_id = self._gw.lease_grant(ttl, timeout=t)
+            if self._gw.put_if_absent(key, value, lease_id, timeout=t):
+                return lease_id
+            try:
+                self._gw.lease_revoke(lease_id, timeout=t)
+            except Exception:
+                pass  # it expires on its own
+            return None
+
+        lease_id = await self._call(attempt)
+        if lease_id is None:
+            return False
+        self._leases[key] = lease_id
+        return True
 
     async def refresh(self, key, value, ttl) -> bool:
-        out = await self._request(
-            "PUT", key,
-            {
-                "value": value,
-                "ttl": int(ttl),
-                "prevExist": "true",
-                "prevValue": value,
-            },
-        )
-        return bool(out) and "errorCode" not in out
+        lease_id = self._leases.get(key)
+        if lease_id is None:
+            return False
+        t = self.REQUEST_TIMEOUT
+
+        def renew() -> bool:
+            if self._gw.lease_keepalive(lease_id, timeout=t) <= 0:
+                return False
+            # The LeaseKV contract: extend iff the key still holds OUR
+            # value. A lease can outlive the key (operator `etcdctl del`
+            # to force a new election, or an overwrite): renewing on the
+            # lease alone would leave two masters.
+            held = self._gw.get(key, timeout=t)
+            return held is not None and held.decode() == value
+
+        ok = await self._call(renew)
+        if not ok:
+            # Mastership is lost; a fresh acquire grants a fresh lease.
+            self._leases.pop(key, None)
+            return False
+        return True
 
     async def get(self, key) -> Optional[str]:
-        out = await self._request("GET", key)
-        if not out or "errorCode" in out:
-            return None
-        return out.get("node", {}).get("value")
+        value = await self._call(
+            lambda: self._gw.get(key, timeout=self.REQUEST_TIMEOUT)
+        )
+        return value.decode() if value is not None else None
 
 
 class KVElection(Election):

@@ -1,0 +1,175 @@
+"""Shared etcd v3 HTTP/JSON gateway client.
+
+One etcd dialect for the whole framework: both the config source
+(server/sources.py, reference go/configuration/configuration.go:56-105)
+and the election lock (server/election.py, reference
+go/server/election/election.go:89-172) speak the v3 gateway exposed by
+every etcd >= 3.4 (`/v3/kv/*`, `/v3/lease/*`, `/v3/watch`). The
+reference used the v2 client API of its era; v2 is gone from modern
+etcd builds, so the TPU framework standardizes on v3.
+
+This image has no etcd client library, so the gateway is urllib over
+the JSON transcoding endpoint; callers run it in an executor thread
+(control-plane path, latency tolerance is seconds). Integration-tested
+against an in-process fake speaking this exact HTTP surface
+(tests/fake_etcd.py) plus live failover scenarios in
+tests/test_etcd_integration.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+from typing import List, Optional
+
+
+def _b64(data: "str | bytes") -> str:
+    if isinstance(data, str):
+        data = data.encode()
+    return base64.b64encode(data).decode()
+
+
+class EtcdGateway:
+    """Minimal etcd v3 gateway client: kv get/put/txn, leases, watch."""
+
+    def __init__(self, endpoints: List[str]):
+        if not endpoints:
+            raise ValueError("etcd gateway needs at least one endpoint")
+        self.endpoints = [
+            (e if "://" in e else f"http://{e}").rstrip("/")
+            for e in endpoints
+        ]
+
+    def _post(self, path: str, payload: dict, timeout: float = 30.0) -> dict:
+        last_err: Exception = RuntimeError("no endpoints")
+        for endpoint in self.endpoints:
+            try:
+                req = urllib.request.Request(
+                    endpoint + path,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read().decode())
+            except Exception as e:  # try the next endpoint
+                last_err = e
+        raise last_err
+
+    # -- kv ------------------------------------------------------------
+
+    def get(self, key: str, timeout: float = 30.0) -> Optional[bytes]:
+        out = self._post("/v3/kv/range", {"key": _b64(key)}, timeout)
+        kvs = out.get("kvs", [])
+        if not kvs:
+            return None
+        return base64.b64decode(kvs[0]["value"])
+
+    def put(
+        self,
+        key: str,
+        value: "str | bytes",
+        lease_id: int = 0,
+        timeout: float = 30.0,
+    ) -> None:
+        payload = {"key": _b64(key), "value": _b64(value)}
+        if lease_id:
+            payload["lease"] = str(lease_id)
+        self._post("/v3/kv/put", payload, timeout)
+
+    def put_if_absent(
+        self,
+        key: str,
+        value: "str | bytes",
+        lease_id: int = 0,
+        timeout: float = 30.0,
+    ) -> bool:
+        """Transactional create: put iff the key does not exist
+        (compare create_revision == 0, the v3 idiom for the v2
+        PrevNoExist acquire the reference election used,
+        election.go:112-117). Returns True when the put happened."""
+        put_op = {"key": _b64(key), "value": _b64(value)}
+        if lease_id:
+            put_op["lease"] = str(lease_id)
+        out = self._post(
+            "/v3/kv/txn",
+            {
+                "compare": [
+                    {
+                        "key": _b64(key),
+                        "target": "CREATE",
+                        "result": "EQUAL",
+                        "create_revision": "0",
+                    }
+                ],
+                "success": [{"request_put": put_op}],
+                "failure": [],
+            },
+            timeout,
+        )
+        return bool(out.get("succeeded"))
+
+    # -- leases ---------------------------------------------------------
+
+    def lease_grant(self, ttl: float, timeout: float = 30.0) -> int:
+        out = self._post(
+            "/v3/lease/grant", {"TTL": str(max(int(ttl), 1))}, timeout
+        )
+        return int(out["ID"])
+
+    def lease_keepalive(self, lease_id: int, timeout: float = 30.0) -> float:
+        """Refresh the lease; returns the new TTL (0 or negative means
+        the lease is gone and the lock key with it)."""
+        out = self._post(
+            "/v3/lease/keepalive", {"ID": str(lease_id)}, timeout
+        )
+        result = out.get("result", out)
+        return float(result.get("TTL", 0))
+
+    def lease_revoke(self, lease_id: int, timeout: float = 30.0) -> None:
+        self._post("/v3/lease/revoke", {"ID": str(lease_id)}, timeout)
+
+    # -- watch ----------------------------------------------------------
+
+    def wait_for_change(self, key: str, timeout: float = 60.0) -> bool:
+        """Block until the key changes (or timeout); one-shot watch.
+
+        /v3/watch is a never-closing newline-delimited JSON stream: the
+        first frame acknowledges watch creation, each later frame carries
+        events. Read frame-by-frame and return on the first event frame.
+
+        Returns True when a watch was actually established (an event
+        arrived, the stream closed cleanly, or it idled past the read
+        timeout after the creation ack) — the caller keeps fast polling.
+        Returns False when every endpoint failed before establishing a
+        watch — the caller should escalate its backoff."""
+        payload = {"create_request": {"key": _b64(key)}}
+        for endpoint in self.endpoints:
+            established = False
+            try:
+                req = urllib.request.Request(
+                    endpoint + "/v3/watch",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            return True  # stream closed cleanly
+                        try:
+                            frame = json.loads(line.decode())
+                        except ValueError:
+                            return True
+                        established = True  # got a frame (creation ack)
+                        result = frame.get("result", frame)
+                        if result.get("events"):
+                            return True  # the key changed
+                        # else: keep waiting for an event frame
+            except Exception:
+                if established:
+                    # Idle timeout on a live watch: healthy, just no
+                    # change within `timeout`.
+                    return True
+                continue  # endpoint failed before the watch existed
+        return False

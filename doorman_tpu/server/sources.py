@@ -7,22 +7,21 @@ first call returns immediately, configuration.go:31-53), Etcd gets then
 watches a key (configuration.go:56-105), and parse_source dispatches on a
 "file:" / "etcd:" prefix (configuration.go:109-121).
 
-The etcd source is gated: this image has no etcd client library, so it
-talks the etcd v3 HTTP/JSON gateway via urllib in an executor thread, and
-raises a clear error at construction if the endpoint list is empty.
+The etcd source speaks the v3 HTTP/JSON gateway through the shared
+client in server/etcd.py — the same API generation the election lock
+uses — in an executor thread, and raises a clear error at construction
+if the endpoint list is empty.
 """
 
 from __future__ import annotations
 
 import asyncio
-import base64
-import json
 import logging
 import signal
-import urllib.request
 import weakref
 from typing import Awaitable, Callable, List, Optional
 
+from doorman_tpu.server.etcd import EtcdGateway
 from doorman_tpu.utils.backoff import MIN_BACKOFF, MAX_BACKOFF, backoff
 
 log = logging.getLogger(__name__)
@@ -77,93 +76,10 @@ def local_file(path: str,
     return source
 
 
-class _EtcdGateway:
-    """Minimal etcd v3 HTTP/JSON gateway client (get + blocking watch)."""
-
-    def __init__(self, endpoints: List[str]):
-        if not endpoints:
-            raise ValueError("etcd source needs at least one endpoint")
-        self.endpoints = [
-            e if "://" in e else f"http://{e}" for e in endpoints
-        ]
-
-    def _post(self, path: str, payload: dict, timeout: float = 30.0) -> dict:
-        last_err: Exception = RuntimeError("no endpoints")
-        for endpoint in self.endpoints:
-            try:
-                req = urllib.request.Request(
-                    endpoint + path,
-                    data=json.dumps(payload).encode(),
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    return json.loads(resp.read().decode())
-            except Exception as e:  # try the next endpoint
-                last_err = e
-        raise last_err
-
-    def get(self, key: str) -> Optional[bytes]:
-        out = self._post(
-            "/v3/kv/range",
-            {"key": base64.b64encode(key.encode()).decode()},
-        )
-        kvs = out.get("kvs", [])
-        if not kvs:
-            return None
-        return base64.b64decode(kvs[0]["value"])
-
-    def wait_for_change(self, key: str, timeout: float = 60.0) -> bool:
-        """Block until the key changes (or timeout); one-shot watch.
-
-        /v3/watch is a never-closing newline-delimited JSON stream: the
-        first frame acknowledges watch creation, each later frame carries
-        events. Read frame-by-frame and return on the first event frame.
-
-        Returns True when a watch was actually established (an event
-        arrived, the stream closed cleanly, or it idled past the read
-        timeout after the creation ack) — the caller keeps fast polling.
-        Returns False when every endpoint failed before establishing a
-        watch — the caller should escalate its backoff."""
-        payload = {
-            "create_request": {
-                "key": base64.b64encode(key.encode()).decode()
-            }
-        }
-        for endpoint in self.endpoints:
-            established = False
-            try:
-                req = urllib.request.Request(
-                    endpoint + "/v3/watch",
-                    data=json.dumps(payload).encode(),
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    while True:
-                        line = resp.readline()
-                        if not line:
-                            return True  # stream closed cleanly
-                        try:
-                            frame = json.loads(line.decode())
-                        except ValueError:
-                            return True
-                        established = True  # got a frame (creation ack)
-                        result = frame.get("result", frame)
-                        if result.get("events"):
-                            return True  # the key changed
-                        # else: keep waiting for an event frame
-            except Exception:
-                if established:
-                    # Idle timeout on a live watch: healthy, just no
-                    # change within `timeout`.
-                    return True
-                continue  # endpoint failed before the watch existed
-        return False
-
-
 def etcd(key: str, endpoints: List[str]) -> Source:
     """Gets `key`, then blocks on a watch for each subsequent version,
     retrying with backoff on errors (configuration.go:56-105)."""
-    gateway = _EtcdGateway(endpoints)
+    gateway = EtcdGateway(endpoints)
     state = {"last": None, "retries": 0}
 
     async def source() -> bytes:

@@ -1,0 +1,270 @@
+"""In-process fake etcd speaking the v3 HTTP/JSON gateway surface.
+
+Implements exactly the endpoints the framework's shared gateway client
+(doorman_tpu/server/etcd.py) uses — /v3/kv/range, /v3/kv/put,
+/v3/kv/txn (create_revision==0 compare), /v3/lease/grant,
+/v3/lease/keepalive, /v3/lease/revoke, and the streaming /v3/watch —
+so the config source and the election lock integration-test against
+the real HTTP dialect without an etcd binary. Leases expire on real
+time (tests use sub-second TTLs); `expire_lease`/`drop_key` inject
+faults.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+def _b64d(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def _b64e(s: "str | bytes") -> str:
+    if isinstance(s, str):
+        s = s.encode()
+    return base64.b64encode(s).decode()
+
+
+class FakeEtcd:
+    """The state machine + HTTP server. Start with `start()`; `address`
+    is host:port for client endpoint lists."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> (value, lease_id, create_revision)
+        self._kv: Dict[str, Tuple[str, int, int]] = {}
+        # lease id -> (ttl_seconds, deadline)
+        self._leases: Dict[int, Tuple[float, float]] = {}
+        self._next_lease = 7_000_000_000_000_000_001
+        self._revision = 1
+        self._changed = threading.Condition(self._lock)
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # -- state machine (called under self._lock) ------------------------
+
+    def _sweep(self) -> None:
+        """Expire lapsed leases and the keys bound to them."""
+        now = time.monotonic()
+        dead = [i for i, (_, dl) in self._leases.items() if dl <= now]
+        for lease_id in dead:
+            del self._leases[lease_id]
+            gone = [k for k, (_, l, _) in self._kv.items() if l == lease_id]
+            for key in gone:
+                del self._kv[key]
+            if gone:
+                self._changed.notify_all()
+
+    def _put(self, key: str, value: str, lease_id: int) -> None:
+        self._revision += 1
+        prev = self._kv.get(key)
+        create_rev = prev[2] if prev else self._revision
+        self._kv[key] = (value, lease_id, create_rev)
+        self._changed.notify_all()
+
+    # -- fault injection -------------------------------------------------
+
+    def expire_lease(self, lease_id: int) -> None:
+        """As if the holder stopped renewing and the TTL lapsed."""
+        with self._lock:
+            self._leases.pop(lease_id, None)
+            gone = [k for k, (_, l, _) in self._kv.items() if l == lease_id]
+            for key in gone:
+                del self._kv[key]
+            self._changed.notify_all()
+
+    def expire_key_lease(self, key: str) -> None:
+        """Expire whatever lease currently holds `key`."""
+        with self._lock:
+            entry = self._kv.get(key)
+        if entry and entry[1]:
+            self.expire_lease(entry[1])
+
+    def drop_key(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+            self._changed.notify_all()
+
+    def value(self, key: str) -> Optional[str]:
+        with self._lock:
+            self._sweep()
+            entry = self._kv.get(key)
+            return entry[0] if entry else None
+
+    # -- HTTP endpoints ---------------------------------------------------
+
+    def handle(self, path: str, body: dict, handler) -> Optional[dict]:
+        """Returns a JSON-able response, or None if `handler` streamed
+        the response itself (/v3/watch)."""
+        if path == "/v3/kv/range":
+            key = _b64d(body["key"])
+            with self._lock:
+                self._sweep()
+                entry = self._kv.get(key)
+            if not entry:
+                return {"count": "0"}
+            return {
+                "count": "1",
+                "kvs": [
+                    {
+                        "key": _b64e(key),
+                        "value": _b64e(entry[0]),
+                        "create_revision": str(entry[2]),
+                    }
+                ],
+            }
+        if path == "/v3/kv/put":
+            key = _b64d(body["key"])
+            value = _b64d(body["value"])
+            lease_id = int(body.get("lease", 0))
+            with self._lock:
+                self._sweep()
+                self._put(key, value, lease_id)
+            return {}
+        if path == "/v3/kv/txn":
+            return self._txn(body)
+        if path == "/v3/lease/grant":
+            ttl = float(body["TTL"])
+            with self._lock:
+                lease_id = self._next_lease
+                self._next_lease += 1
+                self._leases[lease_id] = (ttl, time.monotonic() + ttl)
+            return {"ID": str(lease_id), "TTL": str(int(ttl))}
+        if path == "/v3/lease/keepalive":
+            lease_id = int(body["ID"])
+            with self._lock:
+                self._sweep()
+                entry = self._leases.get(lease_id)
+                if entry is None:
+                    return {"result": {"ID": str(lease_id), "TTL": "0"}}
+                ttl = entry[0]
+                self._leases[lease_id] = (ttl, time.monotonic() + ttl)
+            return {
+                "result": {"ID": str(lease_id), "TTL": str(int(ttl))}
+            }
+        if path == "/v3/lease/revoke":
+            lease_id = int(body["ID"])
+            self.expire_lease(lease_id)
+            return {}
+        if path == "/v3/watch":
+            self._watch(body, handler)
+            return None
+        raise ValueError(f"unhandled path {path}")
+
+    def _txn(self, body: dict) -> dict:
+        """Only the dialect the gateway client emits: a single compare
+        on CREATE == 0 guarding request_put ops."""
+        succeeded = True
+        for cmp in body.get("compare", []):
+            target = cmp.get("target")
+            key = _b64d(cmp["key"])
+            with self._lock:
+                self._sweep()
+                entry = self._kv.get(key)
+            if target == "CREATE":
+                expected = int(cmp.get("create_revision", 0))
+                actual = entry[2] if entry else 0
+                ok = actual == expected
+            else:
+                raise ValueError(f"unhandled txn compare target {target}")
+            if cmp.get("result", "EQUAL") == "EQUAL":
+                succeeded = succeeded and ok
+            else:
+                succeeded = succeeded and not ok
+        ops = body.get("success" if succeeded else "failure", [])
+        responses = []
+        for op in ops:
+            put = op.get("request_put") or op.get("requestPut")
+            if put:
+                with self._lock:
+                    self._put(
+                        _b64d(put["key"]),
+                        _b64d(put["value"]),
+                        int(put.get("lease", 0)),
+                    )
+                responses.append({"response_put": {}})
+        return {"succeeded": succeeded, "responses": responses}
+
+    def _watch(self, body: dict, handler) -> None:
+        """Streamed newline-delimited JSON: creation ack immediately,
+        then one event frame when the key changes (then close)."""
+        key = _b64d(body["create_request"]["key"])
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.end_headers()
+        ack = json.dumps({"result": {"created": True}}) + "\n"
+        handler.wfile.write(ack.encode())
+        handler.wfile.flush()
+        with self._lock:
+            self._sweep()
+            entry = self._kv.get(key)
+            baseline = entry[0] if entry else None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                self._sweep()
+                entry = self._kv.get(key)
+                current = entry[0] if entry else None
+                if current != baseline:
+                    break
+                self._changed.wait(timeout=0.2)
+            event = {
+                "result": {
+                    "events": [
+                        {"kv": {"key": _b64e(key)}}
+                    ]
+                }
+            }
+        handler.wfile.write((json.dumps(event) + "\n").encode())
+        handler.wfile.flush()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> str:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                try:
+                    out = fake.handle(self.path, body, self)
+                except Exception as e:  # pragma: no cover
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                if out is None:
+                    return  # handler streamed its own response
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,184 @@
+"""Etcd v3 integration: the shared gateway client, the config source,
+and the election lock against an in-process fake speaking the exact
+v3 HTTP/JSON surface (tests/fake_etcd.py).
+
+Capability parity: reference election is an etcd TTL lock
+(go/server/election/election.go:89-172) and config watches etcd
+(go/configuration/configuration.go:56-105). Both subsystems here speak
+one API generation (v3) through one client (server/etcd.py)."""
+
+import asyncio
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.server import sources
+from doorman_tpu.server.election import EtcdKV, KVElection
+from doorman_tpu.server.etcd import EtcdGateway
+from tests.fake_etcd import FakeEtcd
+
+
+@pytest.fixture()
+def fake():
+    server = FakeEtcd()
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_gateway_kv_lease_txn_surface(fake):
+    gw = EtcdGateway([fake.address])
+    assert gw.get("/k") is None
+    gw.put("/k", "v1")
+    assert gw.get("/k") == b"v1"
+
+    # Transactional create: only succeeds while the key is absent.
+    assert gw.put_if_absent("/lock", "a") is True
+    assert gw.put_if_absent("/lock", "b") is False
+    assert gw.get("/lock") == b"a"
+
+    # Leases: a key bound to a lease dies with it.
+    lease = gw.lease_grant(10.0)
+    assert gw.put_if_absent("/lease-lock", "holder", lease) is True
+    assert gw.lease_keepalive(lease) > 0
+    gw.lease_revoke(lease)
+    assert gw.get("/lease-lock") is None
+    assert gw.lease_keepalive(lease) == 0
+
+
+def test_config_source_initial_get_and_watch(fake):
+    gw = EtcdGateway([fake.address])
+    gw.put("/config", "capacity: 1")
+    source = sources.etcd("/config", [fake.address])
+
+    async def body():
+        first = await asyncio.wait_for(source(), timeout=10)
+        assert first == b"capacity: 1"
+        # The next version arrives through the watch.
+        waiter = asyncio.ensure_future(source())
+        await asyncio.sleep(0.3)
+        gw.put("/config", "capacity: 2")
+        second = await asyncio.wait_for(waiter, timeout=15)
+        assert second == b"capacity: 2"
+
+    asyncio.run(body())
+
+
+def test_parse_source_etcd_uses_v3_gateway(fake):
+    EtcdGateway([fake.address]).put("/cfg", "x: 1")
+    source = sources.parse_source("etcd:/cfg", etcd_endpoints=[fake.address])
+
+    async def body():
+        assert await asyncio.wait_for(source(), timeout=10) == b"x: 1"
+
+    asyncio.run(body())
+
+
+class Recorder:
+    """Collects election callbacks with an event per transition."""
+
+    def __init__(self):
+        self.is_master = None
+        self.master = ""
+        self.flips = []
+        self.event = asyncio.Event()
+
+    async def on_is_master(self, value):
+        self.is_master = value
+        self.flips.append(value)
+        self.event.set()
+
+    async def on_current(self, value):
+        self.master = value
+        self.event.set()
+
+    async def wait_for(self, predicate, timeout=12.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate(self):
+            remaining = deadline - asyncio.get_event_loop().time()
+            assert remaining > 0, "condition not reached in time"
+            self.event.clear()
+            try:
+                await asyncio.wait_for(self.event.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+
+def test_election_failover_master_lapses_standby_wins(fake):
+    """A wins the TTL lock; when its lease lapses (as if it stopped
+    renewing), A observes the loss on its next renewal and the standby
+    B acquires within a TTL (reference election.go:89-172)."""
+
+    async def body():
+        kv_a, kv_b = EtcdKV([fake.address]), EtcdKV([fake.address])
+        el_a = KVElection(kv_a, "/doorman/master", ttl=0.9)
+        el_b = KVElection(kv_b, "/doorman/master", ttl=0.9)
+        rec_a, rec_b = Recorder(), Recorder()
+
+        await el_a.run("server-a", rec_a.on_is_master, rec_a.on_current)
+        await rec_a.wait_for(lambda r: r.is_master is True)
+        await el_b.run("server-b", rec_b.on_is_master, rec_b.on_current)
+        await rec_b.wait_for(lambda r: r.master == "server-a")
+        assert rec_b.is_master is None  # B never won while A holds
+        assert fake.value("/doorman/master") == "server-a"
+
+        # Fault injection: A's lease lapses server-side. A observes the
+        # loss at its next renewal; it is then retired (a deposed master
+        # immediately re-campaigns — the reacquire test covers that — so
+        # proving the STANDBY wins requires taking A out of the race).
+        fake.expire_key_lease("/doorman/master")
+        await rec_a.wait_for(lambda r: r.is_master is False)
+        assert rec_a.flips[:2] == [True, False]
+        await el_a.stop()
+        await rec_b.wait_for(lambda r: r.is_master is True)
+        await rec_b.wait_for(lambda r: r.master == "server-b")
+        assert fake.value("/doorman/master") == "server-b"
+
+        await el_b.stop()
+
+    asyncio.run(body())
+
+
+def test_master_steps_down_when_key_deleted_despite_live_lease(fake):
+    """Split-brain guard: an operator force-deleting the lock key (the
+    lease itself stays alive) must depose the incumbent at its next
+    renewal — renewing on the lease alone would leave two masters once
+    a standby recreates the key."""
+
+    async def body():
+        kv_a, kv_b = EtcdKV([fake.address]), EtcdKV([fake.address])
+        el_a = KVElection(kv_a, "/lock", ttl=0.9)
+        el_b = KVElection(kv_b, "/lock", ttl=0.9)
+        rec_a, rec_b = Recorder(), Recorder()
+        await el_a.run("a", rec_a.on_is_master, rec_a.on_current)
+        await rec_a.wait_for(lambda r: r.is_master is True)
+        await el_b.run("b", rec_b.on_is_master, rec_b.on_current)
+
+        fake.drop_key("/lock")  # etcdctl del: lease survives, key gone
+        await rec_a.wait_for(lambda r: r.is_master is False)
+        await el_a.stop()  # out of the re-campaign race (see above)
+        await rec_b.wait_for(lambda r: r.is_master is True)
+        assert fake.value("/lock") == "b"
+        await el_b.stop()
+
+    asyncio.run(body())
+
+
+def test_election_reacquire_after_standby_departs(fake):
+    """A deposed master keeps campaigning and retakes the lock when the
+    incumbent's lease lapses."""
+
+    async def body():
+        kv = EtcdKV([fake.address])
+        el = KVElection(kv, "/lock", ttl=0.9)
+        rec = Recorder()
+        await el.run("a", rec.on_is_master, rec.on_current)
+        await rec.wait_for(lambda r: r.is_master is True)
+        fake.expire_key_lease("/lock")
+        await rec.wait_for(lambda r: r.is_master is False)
+        await rec.wait_for(lambda r: r.is_master is True)
+        assert rec.flips == [True, False, True]
+        await el.stop()
+
+    asyncio.run(body())
