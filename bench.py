@@ -433,6 +433,136 @@ def bench_server_tick() -> None:
     )
 
 
+def gate_pallas_kernels() -> None:
+    """Real-TPU pallas regression gate: compile and run BOTH pallas
+    kernels (dense lanes + banded priority water-fill) on the chip and
+    hold them to BASELINE.md's f32 parity bound. CI runs them in
+    interpret mode, which proves semantics but not Mosaic lowering —
+    without this gate a lowering break ships silently. Runs before the
+    timed benchmarks; any failure raises, so the driver records a
+    non-zero rc (the red signal)."""
+    import jax
+    import jax.numpy as jnp
+
+    from doorman_tpu.algorithms import tick as oracle
+    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.solver.dense import DenseBatch
+    from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+    from doorman_tpu.solver.priority import PriorityBatch, solve_priority
+
+    device = jax.devices()[0]
+    if device.platform != "tpu":
+        print(
+            json.dumps(
+                {
+                    "metric": "pallas_tpu_gate",
+                    "value": 0,
+                    "unit": "skipped",
+                    "note": f"platform {device.platform} is not tpu",
+                }
+            )
+        )
+        return
+
+    bound = PALLAS_GATE_REL_BOUND
+    rng = np.random.default_rng(3)
+    R, K = 1024, 128
+
+    # -- dense lanes vs the f64 numpy oracles --------------------------
+    n = rng.integers(1, K, R)
+    act = np.arange(K)[None, :] < n[:, None]
+    wants = (rng.random((R, K)) * 1000 * act).astype(np.float32)
+    has = (rng.random((R, K)) * 500 * act).astype(np.float32)
+    sub = (rng.integers(1, 5, (R, K)) * act).astype(np.float32)
+    cap = (rng.random(R) * 50_000 + 100).astype(np.float32)
+    statc = (rng.random(R) * 100).astype(np.float32)
+    kind = rng.choice(
+        np.array([0, 1, 2, 3, 4], np.int32), R,
+        p=[0.1, 0.1, 0.4, 0.2, 0.2],
+    )
+    put = lambda a: jax.device_put(a, device)
+    batch = DenseBatch(
+        wants=put(wants), has=put(has), subclients=put(sub),
+        active=put(act), capacity=put(cap), algo_kind=put(kind),
+        learning=put(np.zeros(R, bool)), static_capacity=put(statc),
+    )
+    gets = np.asarray(
+        jax.device_get(jax.jit(solve_dense_pallas)(batch)), np.float64
+    )
+    dense_err = 0.0
+    for r in range(R):  # every row: the oracle loop is cheap host numpy
+        m = act[r]
+        w = wants[r, m].astype(np.float64)
+        h = has[r, m].astype(np.float64)
+        s = sub[r, m].astype(np.float64)
+        k, c = int(kind[r]), float(cap[r])
+        if k == AlgoKind.NO_ALGORITHM:
+            expected = oracle.none_tick(w)
+        elif k == AlgoKind.STATIC:
+            expected = oracle.static_tick(float(statc[r]), w)
+        elif k == AlgoKind.PROPORTIONAL_SHARE:
+            expected = oracle.proportional_snapshot(c, w, h)
+        elif k == AlgoKind.PROPORTIONAL_TOPUP:
+            expected = oracle.proportional_topup_snapshot(c, w, h, s)
+        else:
+            expected = oracle.fair_share_waterfill(c, w, s)
+        scale = max(c, float(w.max()) if len(w) else 0.0, 1e-30)
+        err = float(np.abs(gets[r, m] - expected).max()) / scale
+        dense_err = max(dense_err, err)
+        if err > bound:
+            raise AssertionError(
+                f"pallas_dense on-chip error {err:.3g} exceeds "
+                f"{bound:g} (row {r}, kind {k})"
+            )
+
+    # -- banded priority water-fill: pallas vs XLA, on chip, with
+    #    group caps engaged (the bisection evaluates the kernel) -------
+    band = (rng.integers(0, 4, (R, K)) * act).astype(np.int32)
+    group = rng.choice(np.array([-1, 0, 1], np.int32), R)
+    group_cap = np.asarray(
+        [cap[group == 0].sum() * 0.5, cap[group == 1].sum() * 0.25],
+        np.float32,
+    )
+    pbatch = PriorityBatch(
+        wants=put(wants), weights=put(np.maximum(sub, act)),
+        band=put(band), active=put(act), capacity=put(cap),
+        group=put(group), group_cap=put(group_cap),
+    )
+    g_xla = np.asarray(
+        jax.device_get(solve_priority(pbatch, num_bands=4)), np.float64
+    )
+    g_pal = np.asarray(
+        jax.device_get(
+            solve_priority(pbatch, num_bands=4, use_pallas=True)
+        ),
+        np.float64,
+    )
+    scale = np.maximum(cap.astype(np.float64), 1e-30)[:, None]
+    prio_err = float((np.abs(g_pal - g_xla) / scale).max())
+    if prio_err > bound:
+        raise AssertionError(
+            f"pallas_priority on-chip divergence {prio_err:.3g} vs the "
+            f"XLA solve exceeds {bound:g}"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "pallas_tpu_gate",
+                "value": 1,
+                "unit": "ok",
+                "dense_rel_err": float(f"{dense_err:.3g}"),
+                "priority_rel_err": float(f"{prio_err:.3g}"),
+                "bound": bound,
+            }
+        )
+    )
+
+
+# BASELINE.md parity ladder: the f32/pallas path must stay within this
+# bound of the f64 oracles (tests/test_f32_parity.py pins the same
+# number off-chip).
+PALLAS_GATE_REL_BOUND = 1e-6
+
 # The server tick has its own target: the BASELINE.md north star is
 # <100 ms per recompute of the full 1M-lease table, measured here
 # end-to-end through the store of record.
@@ -444,5 +574,6 @@ TICKS_SERVER = 24
 
 
 if __name__ == "__main__":
+    gate_pallas_kernels()
     main()
     bench_server_tick()

@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import logging
 import time
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from doorman_tpu.server.etcd import EtcdGateway
+
+log = logging.getLogger(__name__)
 
 IsMasterCallback = Callable[[bool], Awaitable[None]]
 CurrentMasterCallback = Callable[[str], Awaitable[None]]
@@ -127,7 +130,11 @@ class EtcdKV(LeaseKV):
 
     # Mastership-loss detection must fit inside KVElection's renewal
     # cadence (ttl/3 with ttl defaulting to 10s), not the gateway's
-    # lenient config-watch default.
+    # lenient config-watch default. This bounds BOTH each HTTP request
+    # and (via asyncio.wait_for in _call) the whole operation — with
+    # several endpoints the per-endpoint retries would otherwise stack
+    # past the lock TTL and re-open the split-brain window the timeout
+    # exists to close.
     REQUEST_TIMEOUT = 5.0
 
     def __init__(self, endpoints: list[str]):
@@ -136,10 +143,15 @@ class EtcdKV(LeaseKV):
 
     async def _call(self, fn):
         try:
-            return await asyncio.get_running_loop().run_in_executor(
-                None, fn
+            return await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(None, fn),
+                self.REQUEST_TIMEOUT,
             )
-        except Exception:
+        except Exception as e:
+            # Failures are expected during partitions, but silence here
+            # would make a misconfigured endpoint list undiagnosable —
+            # the campaign loop would just never win, quietly.
+            log.warning("etcd election request failed: %r", e)
             return None
 
     async def acquire(self, key, value, ttl) -> bool:
@@ -179,8 +191,22 @@ class EtcdKV(LeaseKV):
             # value. A lease can outlive the key (operator `etcdctl del`
             # to force a new election, or an overwrite): renewing on the
             # lease alone would leave two masters.
-            held = self._gw.get(key, timeout=t)
-            return held is not None and held.decode() == value
+            try:
+                held = self._gw.get(key, timeout=t)
+                ours = held is not None and held.decode() == value
+            except Exception:
+                ours = False  # can't verify ownership: step down
+            if not ours:
+                # The keepalive above just re-extended the lease to a
+                # full TTL; abandoning it now would pin a stale lock key
+                # for that long with nobody renewing — a full-TTL
+                # leaderless window. Release it so re-election is
+                # immediate.
+                try:
+                    self._gw.lease_revoke(lease_id, timeout=t)
+                except Exception:
+                    pass  # unreachable etcd: the TTL is the backstop
+            return ours
 
         ok = await self._call(renew)
         if not ok:

@@ -199,6 +199,7 @@ class FakeEtcd:
         ack = json.dumps({"result": {"created": True}}) + "\n"
         handler.wfile.write(ack.encode())
         handler.wfile.flush()
+        changed = False
         with self._lock:
             self._sweep()
             entry = self._kv.get(key)
@@ -209,17 +210,19 @@ class FakeEtcd:
                 entry = self._kv.get(key)
                 current = entry[0] if entry else None
                 if current != baseline:
+                    changed = True
                     break
                 self._changed.wait(timeout=0.2)
+        # Write outside the lock: a stalled watch client must not block
+        # every other request. On idle timeout just close the stream (no
+        # phantom event) — the client treats a clean close as a healthy
+        # idle watch, matching real etcd's no-event stream.
+        if changed:
             event = {
-                "result": {
-                    "events": [
-                        {"kv": {"key": _b64e(key)}}
-                    ]
-                }
+                "result": {"events": [{"kv": {"key": _b64e(key)}}]}
             }
-        handler.wfile.write((json.dumps(event) + "\n").encode())
-        handler.wfile.flush()
+            handler.wfile.write((json.dumps(event) + "\n").encode())
+            handler.wfile.flush()
 
     # -- lifecycle ---------------------------------------------------------
 
