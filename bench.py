@@ -55,30 +55,19 @@ RUNS = 5  # best-of: the tunnel link is shared and bursty
 
 def spot_check(wants, has, active, capacity, kind, static_cap, gets):
     """Validate a handful of resources against the numpy oracles."""
-    from doorman_tpu.algorithms import tick as oracle
-    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.algorithms.tick import oracle_row
 
     rng = np.random.default_rng(7)
     for r in rng.integers(0, wants.shape[0], 25):
         m = active[r]
         w = wants[r, m].astype(np.float64)
-        h = has[r, m].astype(np.float64)
-        s = np.ones_like(w)
-        c = float(capacity[r])
-        k = int(kind[r])
-        if k == AlgoKind.NO_ALGORITHM:
-            expected = oracle.none_tick(w)
-        elif k == AlgoKind.STATIC:
-            expected = oracle.static_tick(float(static_cap[r]), w)
-        elif k == AlgoKind.PROPORTIONAL_SHARE:
-            expected = oracle.proportional_snapshot(c, w, h)
-        elif k == AlgoKind.PROPORTIONAL_TOPUP:
-            expected = oracle.proportional_topup_snapshot(c, w, h, s)
-        else:
-            expected = oracle.fair_share_waterfill(c, w, s)
+        expected = oracle_row(
+            int(kind[r]), float(capacity[r]), float(static_cap[r]),
+            w, has[r, m].astype(np.float64), np.ones_like(w),
+        )
         np.testing.assert_allclose(
             gets[r, m].astype(np.float64), expected, rtol=2e-6, atol=1e-4,
-            err_msg=f"resource {r} kind {k}",
+            err_msg=f"resource {r} kind {int(kind[r])}",
         )
 
 
@@ -442,10 +431,8 @@ def gate_pallas_kernels() -> None:
     timed benchmarks; any failure raises, so the driver records a
     non-zero rc (the red signal)."""
     import jax
-    import jax.numpy as jnp
 
-    from doorman_tpu.algorithms import tick as oracle
-    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.algorithms.tick import oracle_row
     from doorman_tpu.solver.dense import DenseBatch
     from doorman_tpu.solver.pallas_dense import solve_dense_pallas
     from doorman_tpu.solver.priority import PriorityBatch, solve_priority
@@ -493,26 +480,18 @@ def gate_pallas_kernels() -> None:
     for r in range(R):  # every row: the oracle loop is cheap host numpy
         m = act[r]
         w = wants[r, m].astype(np.float64)
-        h = has[r, m].astype(np.float64)
-        s = sub[r, m].astype(np.float64)
-        k, c = int(kind[r]), float(cap[r])
-        if k == AlgoKind.NO_ALGORITHM:
-            expected = oracle.none_tick(w)
-        elif k == AlgoKind.STATIC:
-            expected = oracle.static_tick(float(statc[r]), w)
-        elif k == AlgoKind.PROPORTIONAL_SHARE:
-            expected = oracle.proportional_snapshot(c, w, h)
-        elif k == AlgoKind.PROPORTIONAL_TOPUP:
-            expected = oracle.proportional_topup_snapshot(c, w, h, s)
-        else:
-            expected = oracle.fair_share_waterfill(c, w, s)
+        c = float(cap[r])
+        expected = oracle_row(
+            int(kind[r]), c, float(statc[r]), w,
+            has[r, m].astype(np.float64), sub[r, m].astype(np.float64),
+        )
         scale = max(c, float(w.max()) if len(w) else 0.0, 1e-30)
         err = float(np.abs(gets[r, m] - expected).max()) / scale
         dense_err = max(dense_err, err)
         if err > bound:
             raise AssertionError(
                 f"pallas_dense on-chip error {err:.3g} exceeds "
-                f"{bound:g} (row {r}, kind {k})"
+                f"{bound:g} (row {r}, kind {int(kind[r])})"
             )
 
     # -- banded priority water-fill: pallas vs XLA, on chip, with
@@ -558,10 +537,11 @@ def gate_pallas_kernels() -> None:
     )
 
 
-# BASELINE.md parity ladder: the f32/pallas path must stay within this
-# bound of the f64 oracles (tests/test_f32_parity.py pins the same
-# number off-chip).
-PALLAS_GATE_REL_BOUND = 1e-6
+# BASELINE.md parity ladder bound: ONE constant, shared with the
+# off-chip pin in tests/test_f32_parity.py via algorithms.tick.
+from doorman_tpu.algorithms.tick import (
+    F32_PARITY_REL_BOUND as PALLAS_GATE_REL_BOUND,
+)
 
 # The server tick has its own target: the BASELINE.md north star is
 # <100 ms per recompute of the full 1M-lease table, measured here

@@ -43,6 +43,8 @@ __all__ = [
     "proportional_topup_snapshot",
     "fair_share_waterfill",
     "waterfill_level",
+    "oracle_row",
+    "F32_PARITY_REL_BOUND",
 ]
 
 
@@ -184,3 +186,38 @@ def fair_share_waterfill(
         return wants.copy()
     level = waterfill_level(capacity, wants, sub)
     return np.minimum(wants, level * sub)
+
+
+# The ONE f32 parity bound (BASELINE.md "parity ladder"): the f32 /
+# pallas solve must stay within this of the f64 oracles, relative to the
+# row's grant scale. Enforced off-chip by tests/test_f32_parity.py and
+# on-chip by bench.gate_pallas_kernels — both import it from here so a
+# re-characterization cannot desynchronize the two gates.
+F32_PARITY_REL_BOUND = 1e-6
+
+
+def oracle_row(
+    kind: int,
+    capacity: float,
+    static_capacity: float,
+    wants: np.ndarray,
+    has: np.ndarray,
+    subclients: np.ndarray,
+) -> np.ndarray:
+    """Dispatch one resource row to its lane oracle — the shared
+    comparison helper for every f32/pallas parity check."""
+    from doorman_tpu.algorithms.kinds import AlgoKind
+
+    if kind == AlgoKind.NO_ALGORITHM:
+        return none_tick(wants)
+    if kind == AlgoKind.STATIC:
+        return static_tick(static_capacity, wants)
+    if kind == AlgoKind.PROPORTIONAL_SHARE:
+        return proportional_snapshot(capacity, wants, has)
+    if kind == AlgoKind.PROPORTIONAL_TOPUP:
+        return proportional_topup_snapshot(
+            capacity, wants, has, subclients
+        )
+    if kind == AlgoKind.FAIR_SHARE:
+        return fair_share_waterfill(capacity, wants, subclients)
+    raise ValueError(f"no scalar oracle for algorithm lane {kind}")

@@ -11,6 +11,10 @@ A second mesh axis ("dc") models the two-level tree.
 """
 
 from doorman_tpu.parallel.mesh import make_mesh  # noqa: F401
+from doorman_tpu.parallel.multihost import (  # noqa: F401
+    make_multihost_mesh,
+    pack_process_edges,
+)
 from doorman_tpu.parallel.sharded import (  # noqa: F401
     make_sharded_dense_solver,
     make_sharded_solver,

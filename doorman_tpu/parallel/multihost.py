@@ -1,0 +1,220 @@
+"""Multi-host execution: runtime wiring + host-local shard packing.
+
+The reference spans hosts by construction — its server tree is processes
+on different machines talking gRPC (doc/design.md:204-220 in
+/root/reference). The TPU framework's equivalent for the *solve* is a
+multi-host TPU slice: every host runs the same program, the mesh spans
+all hosts' chips, and collectives ride ICI. This module is the glue the
+design doc's recipe describes (doc/design.md "Multi-host"):
+
+  * `initialize()` — `jax.distributed.initialize` wiring with
+    `DOORMAN_*` env fallbacks (utils/flagenv.py convention), idempotent;
+  * `make_multihost_mesh()` — a ("dc", "clients") mesh whose leading
+    axis follows process boundaries, so each host's chips form its own
+    "dc" block (the intermediate-server role of the fused tree) and the
+    per-dc partial aggregation never leaves the host's chips;
+  * `local_edge_block()` / `pack_process_edges()` — each host packs ONLY
+    its own clients' edges (the leases its RPC frontends own) and the
+    global sharded EdgeBatch is assembled with
+    `jax.make_array_from_process_local_data`, so edge tables never cross
+    DCN; the psum inside the sharded solve is the only cross-host
+    traffic.
+
+The packing math is pure (unit-tested on the CPU mesh in
+tests/test_multihost.py); `__graft_entry__.dryrun_multichip` runs the
+same path end-to-end against the single-device solve.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from doorman_tpu.solver.kernels import EdgeBatch
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: "Optional[Sequence[int]]" = None,
+) -> None:
+    """`jax.distributed.initialize` with DOORMAN_* env fallbacks.
+
+    Call once per process before any other JAX use, on every host of
+    the slice. No-ops when already initialized or when neither
+    arguments nor env vars name a coordinator (single-host runs).
+    Env: DOORMAN_COORDINATOR (host:port), DOORMAN_NUM_PROCESSES,
+    DOORMAN_PROCESS_ID.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "DOORMAN_COORDINATOR"
+    )
+    if coordinator_address is None:
+        return  # single-host: the default runtime is already correct
+    if num_processes is None and "DOORMAN_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DOORMAN_NUM_PROCESSES"])
+    if process_id is None and "DOORMAN_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DOORMAN_PROCESS_ID"])
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    log.info(
+        "multihost runtime up: process %d/%d, %d local of %d global devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.local_devices()), len(jax.devices()),
+    )
+
+
+def make_multihost_mesh(
+    axis_names: Tuple[str, ...] = ("dc", "clients"),
+    devices: Optional[Sequence] = None,
+):
+    """Mesh over all hosts' devices with the leading axis following
+    process boundaries: host i's chips are block i of the first axis.
+
+    With per-host shards packed host-locally (`pack_process_edges`),
+    this layout keeps every edge's data on its owner's chips; the
+    leading axis doubles as the "dc" level of the fused two-level tree
+    (parallel/sharded.py `dc_aggregates`). Falls back to a single axis
+    when `axis_names` has one name."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(
+        devices, key=lambda d: (d.process_index, getattr(d, "id", 0))
+    )
+    n = len(devices)
+    n_proc = len({d.process_index for d in devices})
+    if len(axis_names) == 1:
+        return Mesh(np.array(devices), axis_names)
+    if n % n_proc:
+        raise ValueError(
+            f"{n} devices do not split evenly over {n_proc} processes"
+        )
+    dev_array = np.array(devices).reshape(n_proc, n // n_proc)
+    return Mesh(dev_array, axis_names)
+
+
+# -- host-local edge packing (pure math, unit-testable) -----------------
+
+
+def pad_edge_block(edges: EdgeBatch, size: int) -> EdgeBatch:
+    """Pad a host's local edge arrays to the agreed per-host block
+    `size` with inactive edges (the solve masks them out). The fill
+    resource id repeats the block's last id so per-shard edge lists
+    stay sorted by segment — the segment reductions rely on it."""
+    arrs = {
+        "resource": np.asarray(edges.resource),
+        "wants": np.asarray(edges.wants),
+        "has": np.asarray(edges.has),
+        "subclients": np.asarray(edges.subclients),
+        "active": np.asarray(edges.active),
+    }
+    e = arrs["active"].shape[0]
+    if e > size:
+        raise ValueError(
+            f"host holds {e} edges, over the per-host block size {size}"
+        )
+    pad = size - e
+    if pad == 0:
+        return EdgeBatch(**arrs)
+    last_rid = arrs["resource"][-1] if e else 0
+    fills = {
+        "resource": last_rid, "wants": 0, "has": 0, "subclients": 0,
+        "active": False,
+    }
+    return EdgeBatch(
+        **{
+            k: np.concatenate(
+                [v, np.full((pad,), fills[k], dtype=v.dtype)]
+            )
+            for k, v in arrs.items()
+        }
+    )
+
+
+def pack_process_edges(
+    mesh, local_edges: EdgeBatch, edges_per_host: int
+) -> EdgeBatch:
+    """Assemble the global sharded EdgeBatch from THIS host's edges.
+
+    Every host calls this with its own clients' edge list (padded here
+    to `edges_per_host`, which all hosts must agree on — it is config,
+    not data); `jax.make_array_from_process_local_data` lays host i's
+    block onto host i's chips, so nothing crosses DCN. The result is
+    addressable shard-wise and feeds parallel.sharded.make_sharded_solver
+    directly. Single-process: equivalent to shard_edges (same layout).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = int(mesh.devices.size)
+    n_proc = max(
+        len({d.process_index for d in mesh.devices.flat}), 1
+    )
+    # The edge axis shards over every mesh axis, so the global length
+    # must divide by the device count — round the per-host block up to
+    # a multiple of the per-host device count (deterministic from mesh
+    # shape + config, so every host agrees).
+    per_host_dev = max(n_dev // n_proc, 1)
+    edges_per_host += (-edges_per_host) % per_host_dev
+    block = pad_edge_block(local_edges, edges_per_host)
+    global_e = edges_per_host * n_proc
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+    def assemble(local: np.ndarray) -> "jax.Array":
+        return jax.make_array_from_process_local_data(
+            sharding, local, (global_e,) + local.shape[1:]
+        )
+
+    return EdgeBatch(
+        resource=assemble(np.asarray(block.resource)),
+        wants=assemble(np.asarray(block.wants)),
+        has=assemble(np.asarray(block.has)),
+        subclients=assemble(np.asarray(block.subclients)),
+        active=assemble(np.asarray(block.active)),
+    )
+
+
+def split_edges_by_host(
+    edges: EdgeBatch, n_hosts: int
+) -> "list[EdgeBatch]":
+    """Deal a global edge list into `n_hosts` contiguous blocks (test
+    and simulation helper: it models which edges each host's RPC
+    frontends would own). Blocks keep global order, so reassembly by
+    concatenation is the identity — the invariant the packing tests
+    pin."""
+    e = int(np.asarray(edges.active).shape[0])
+    bounds = np.linspace(0, e, n_hosts + 1).astype(int)
+    out = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        out.append(
+            EdgeBatch(
+                resource=np.asarray(edges.resource)[a:b],
+                wants=np.asarray(edges.wants)[a:b],
+                has=np.asarray(edges.has)[a:b],
+                subclients=np.asarray(edges.subclients)[a:b],
+                active=np.asarray(edges.active)[a:b],
+            )
+        )
+    return out

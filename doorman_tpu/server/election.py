@@ -154,8 +154,34 @@ class EtcdKV(LeaseKV):
             log.warning("etcd election request failed: %r", e)
             return None
 
+    def _spawn_revoke(self, lease_id: "int | None") -> None:
+        """Best-effort background revoke of a lease whose operation we
+        abandoned (asyncio.wait_for cannot cancel the executor thread,
+        and the thread's etcd side effects — a granted lease, a
+        just-extended TTL, even a lock acquired after we gave up on it
+        — would otherwise pin a stale key for a full TTL with nobody
+        renewing it)."""
+        if not lease_id:
+            return
+
+        def revoke():
+            try:
+                self._gw.lease_revoke(
+                    lease_id, timeout=self.REQUEST_TIMEOUT
+                )
+            except Exception:
+                pass  # unreachable etcd: the TTL is the backstop
+
+        try:
+            asyncio.get_running_loop().run_in_executor(None, revoke)
+        except RuntimeError:
+            pass  # loop shutting down
+
     async def acquire(self, key, value, ttl) -> bool:
         t = self.REQUEST_TIMEOUT
+        # The thread records its granted lease here so an abandoned
+        # (timed-out) attempt can still be revoked from the outside.
+        in_flight: Dict[str, int] = {}
 
         def attempt() -> Optional[int]:
             # Cheap existence probe first: the standby's campaign loop
@@ -164,6 +190,7 @@ class EtcdKV(LeaseKV):
             if self._gw.get(key, timeout=t) is not None:
                 return None
             lease_id = self._gw.lease_grant(ttl, timeout=t)
+            in_flight["lease"] = lease_id
             if self._gw.put_if_absent(key, value, lease_id, timeout=t):
                 return lease_id
             try:
@@ -174,6 +201,11 @@ class EtcdKV(LeaseKV):
 
         lease_id = await self._call(attempt)
         if lease_id is None:
+            # Timed out or failed: if the thread got as far as a lease
+            # grant (and possibly even won the lock after we stopped
+            # waiting), revoke it — we are about to report "not master",
+            # so that lock must not survive unrenewed.
+            self._spawn_revoke(in_flight.get("lease"))
             return False
         self._leases[key] = lease_id
         return True
@@ -211,6 +243,11 @@ class EtcdKV(LeaseKV):
         ok = await self._call(renew)
         if not ok:
             # Mastership is lost; a fresh acquire grants a fresh lease.
+            # On a timeout the thread may still be mid-renewal (and may
+            # have just extended the TTL): revoke so the lock is not
+            # pinned by a master that has already stepped down.
+            if ok is None:
+                self._spawn_revoke(lease_id)
             self._leases.pop(key, None)
             return False
         return True

@@ -60,6 +60,10 @@ class FakeEtcd:
                 self._changed.notify_all()
 
     def _put(self, key: str, value: str, lease_id: int) -> None:
+        if lease_id and lease_id not in self._leases:
+            # Real etcd rejects puts naming a revoked/unknown lease;
+            # accepting them would create keys the sweep never expires.
+            raise ValueError("etcdserver: requested lease not found")
         self._revision += 1
         prev = self._kv.get(key)
         create_rev = prev[2] if prev else self._revision

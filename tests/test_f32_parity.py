@@ -21,13 +21,14 @@ import jax.numpy as jnp
 
 import tests.conftest  # noqa: F401
 
-from doorman_tpu.algorithms import tick as oracle
 from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.algorithms.tick import F32_PARITY_REL_BOUND, oracle_row
 from doorman_tpu.solver.dense import DenseBatch, solve_dense
 
 # The documented f32 bound: max |gets_f32 - oracle_f64| per row,
-# relative to max(capacity, max wants) of that row.
-F32_REL_BOUND = 1e-6
+# relative to max(capacity, max wants) of that row. ONE constant shared
+# with bench.py's on-chip pallas gate (algorithms.tick owns it).
+F32_REL_BOUND = F32_PARITY_REL_BOUND
 
 R, K = 1024, 128  # 1024 resources x up to 128 clients per solve
 SCALES = (1e-2, 1.0, 1e3, 1e6)
@@ -65,18 +66,6 @@ def _solve_f32(kind, act, wants, has, sub, cap, statc, learning=False):
     return np.asarray(solve_dense(batch), np.float64)
 
 
-def _oracle_row(kind, cap, statc, w, h, s):
-    if kind == AlgoKind.NO_ALGORITHM:
-        return oracle.none_tick(w)
-    if kind == AlgoKind.STATIC:
-        return oracle.static_tick(statc, w)
-    if kind == AlgoKind.PROPORTIONAL_SHARE:
-        return oracle.proportional_snapshot(cap, w, h)
-    if kind == AlgoKind.PROPORTIONAL_TOPUP:
-        return oracle.proportional_topup_snapshot(cap, w, h, s)
-    return oracle.fair_share_waterfill(cap, w, s)
-
-
 def test_f32_error_bounded_across_lanes_and_magnitudes():
     worst = 0.0
     for scale in SCALES:
@@ -91,8 +80,8 @@ def test_f32_error_bounded_across_lanes_and_magnitudes():
                 m = act[r]
                 w, h = wants[r, m], has[r, m]
                 s = sub[r, m].astype(np.float64)
-                expected = _oracle_row(
-                    kind, float(cap[r]), float(statc[r]), w, h, s
+                expected = oracle_row(
+                    int(kind), float(cap[r]), float(statc[r]), w, h, s
                 )
                 row_scale = max(
                     float(cap[r]), float(w.max()) if len(w) else 0.0, 1e-30

@@ -1,0 +1,108 @@
+"""Multi-host packing math and the host-shard solve path on the CPU mesh.
+
+True multi-process slices can't run under pytest, but everything pure is
+pinned here: the per-host block padding, the deal/reassemble identity,
+the process-ordered mesh layout, and the single-process
+`pack_process_edges` path solved end-to-end against the single-device
+oracle (the same path `__graft_entry__.dryrun_multichip` exercises).
+Reference being matched: the server tree spans hosts by construction
+(doc/design.md:204-220)."""
+
+import numpy as np
+import jax
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.parallel import (
+    make_sharded_solver,
+    pack_process_edges,
+)
+from doorman_tpu.parallel.multihost import (
+    make_multihost_mesh,
+    pad_edge_block,
+    split_edges_by_host,
+)
+from doorman_tpu.parallel.sharded import replicate_resources
+from doorman_tpu.solver.kernels import EdgeBatch, ResourceBatch, solve_tick
+
+
+def edge_world(E=96, R=12, seed=0):
+    rng = np.random.default_rng(seed)
+    rid = np.sort(rng.integers(0, R, E).astype(np.int32))
+    edges = EdgeBatch(
+        resource=rid,
+        wants=rng.integers(0, 100, E).astype(np.float64),
+        has=rng.integers(0, 50, E).astype(np.float64),
+        subclients=np.ones(E),
+        active=np.ones(E, bool),
+    )
+    resources = ResourceBatch(
+        capacity=rng.integers(100, 5000, R).astype(np.float64),
+        algo_kind=rng.integers(0, 5, R).astype(np.int32),
+        learning=np.zeros(R, bool),
+        static_capacity=rng.integers(1, 100, R).astype(np.float64),
+    )
+    return edges, resources
+
+
+def test_pad_edge_block_math():
+    edges, _ = edge_world(E=10)
+    block = pad_edge_block(edges, 16)
+    assert np.asarray(block.active).shape == (16,)
+    assert np.asarray(block.active)[10:].sum() == 0  # padding inactive
+    assert (np.asarray(block.wants)[10:] == 0).all()
+    # Fill rid repeats the last id: the block stays sorted by segment.
+    rid = np.asarray(block.resource)
+    assert (np.diff(rid) >= 0).all()
+    assert (rid[10:] == rid[9]).all()
+    # Exact-size block is the identity.
+    same = pad_edge_block(edges, 10)
+    np.testing.assert_array_equal(np.asarray(same.wants),
+                                  np.asarray(edges.wants))
+    with pytest.raises(ValueError):
+        pad_edge_block(edges, 9)
+
+
+def test_split_then_concat_is_identity():
+    edges, _ = edge_world(E=97)  # deliberately not divisible
+    parts = split_edges_by_host(edges, 4)
+    assert sum(np.asarray(p.active).shape[0] for p in parts) == 97
+    for field in ("resource", "wants", "has", "subclients", "active"):
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(getattr(p, field)) for p in parts]),
+            np.asarray(getattr(edges, field)),
+        )
+
+
+def test_multihost_mesh_layout_follows_process_blocks():
+    devices = jax.devices("cpu")[:8]
+    mesh = make_multihost_mesh(("dc", "clients"), devices)
+    # Single process: one dc block holding all its chips, in id order.
+    assert dict(mesh.shape) == {"dc": 1, "clients": 8}
+    flat = list(mesh.devices.flat)
+    assert [d.id for d in flat] == sorted(d.id for d in flat)
+    single = make_multihost_mesh(("clients",), devices)
+    assert dict(single.shape) == {"clients": 8}
+
+
+def test_pack_process_edges_solves_to_single_device_result():
+    """The host-local packing path end-to-end: pad to the per-host
+    block, assemble via make_array_from_process_local_data, solve
+    sharded, compare with the unsharded solve."""
+    devices = jax.devices("cpu")[:8]
+    mesh = make_multihost_mesh(("dc", "clients"), devices)
+    edges, resources = edge_world(E=90, R=11, seed=3)
+    # Per-host block of 96 (> 90: exercises the inactive padding).
+    packed = pack_process_edges(mesh, edges, edges_per_host=96)
+    assert np.asarray(packed.active).shape == (96,)
+
+    solve = make_sharded_solver(mesh)
+    gets = np.asarray(
+        jax.block_until_ready(
+            solve(packed, replicate_resources(mesh, resources))
+        )
+    )
+    expected = np.asarray(jax.jit(solve_tick)(edges, resources))
+    np.testing.assert_allclose(gets[:90], expected, rtol=1e-12, atol=1e-12)
+    assert (gets[90:] == 0).all()  # padded edges granted nothing
