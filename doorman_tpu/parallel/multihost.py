@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -104,12 +104,20 @@ def make_multihost_mesh(
         devices, key=lambda d: (d.process_index, getattr(d, "id", 0))
     )
     n = len(devices)
-    n_proc = len({d.process_index for d in devices})
     if len(axis_names) == 1:
         return Mesh(np.array(devices), axis_names)
-    if n % n_proc:
+    # Every process must contribute the SAME device count, else the
+    # reshape below would put one host's chips into another host's
+    # "dc" row and the host-local packing invariant silently breaks
+    # (total-count divisibility alone cannot catch 3+5 over 2 hosts).
+    counts: Dict[int, int] = {}
+    for d in devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    n_proc = len(counts)
+    if len(set(counts.values())) > 1:
         raise ValueError(
-            f"{n} devices do not split evenly over {n_proc} processes"
+            f"uneven devices per process {counts}: the dc-axis layout "
+            "requires every host to contribute the same device count"
         )
     dev_array = np.array(devices).reshape(n_proc, n // n_proc)
     return Mesh(dev_array, axis_names)

@@ -179,9 +179,18 @@ class EtcdKV(LeaseKV):
 
     async def acquire(self, key, value, ttl) -> bool:
         t = self.REQUEST_TIMEOUT
-        # The thread records its granted lease here so an abandoned
-        # (timed-out) attempt can still be revoked from the outside.
-        in_flight: Dict[str, int] = {}
+        # Shared with the executor thread: `lease` is the granted lease
+        # (if any), `abandoned` is set when the caller stops waiting.
+        # Every interleaving must end with an unrenewed lock revoked:
+        #   - timeout before the grant: caller sees lease=None (no-op),
+        #     the thread later finds abandoned=True after its put and
+        #     self-revokes;
+        #   - timeout after the grant: the caller revokes the recorded
+        #     lease AND the thread self-revokes on its abandoned check
+        #     (double revoke of a dead lease is harmless);
+        #   - clean race loss: the thread revokes inline and clears
+        #     `lease`, so the caller does not issue a redundant revoke.
+        state: Dict[str, object] = {"lease": None, "abandoned": False}
 
         def attempt() -> Optional[int]:
             # Cheap existence probe first: the standby's campaign loop
@@ -190,22 +199,23 @@ class EtcdKV(LeaseKV):
             if self._gw.get(key, timeout=t) is not None:
                 return None
             lease_id = self._gw.lease_grant(ttl, timeout=t)
-            in_flight["lease"] = lease_id
-            if self._gw.put_if_absent(key, value, lease_id, timeout=t):
-                return lease_id
-            try:
-                self._gw.lease_revoke(lease_id, timeout=t)
-            except Exception:
-                pass  # it expires on its own
-            return None
+            state["lease"] = lease_id
+            won = self._gw.put_if_absent(key, value, lease_id, timeout=t)
+            if state["abandoned"] or not won:
+                try:
+                    self._gw.lease_revoke(lease_id, timeout=t)
+                except Exception:
+                    pass  # it expires on its own
+                state["lease"] = None
+                return None
+            return lease_id
 
         lease_id = await self._call(attempt)
         if lease_id is None:
-            # Timed out or failed: if the thread got as far as a lease
-            # grant (and possibly even won the lock after we stopped
-            # waiting), revoke it — we are about to report "not master",
-            # so that lock must not survive unrenewed.
-            self._spawn_revoke(in_flight.get("lease"))
+            # We are about to report "not master": no lock created by
+            # the (possibly still-running) thread may survive unrenewed.
+            state["abandoned"] = True
+            self._spawn_revoke(state["lease"])
             return False
         self._leases[key] = lease_id
         return True

@@ -82,18 +82,24 @@ class Reporter:
         ]
         if not post:
             return {"utilization": 0.0, "samples": 0, "overage_events": 0,
-                    "max_overage": 0.0}
+                    "max_overage": 0.0, "avg_overage": 0.0}
         overloaded = [s for s in post if s.sum_wants >= s.capacity]
         basis = overloaded or post
         utilization = sum(
             min(s.sum_has, s.capacity) / s.capacity for s in basis
         ) / len(basis)
         over = [s for s in post if s.sum_has > s.capacity * 1.001]
+        # Shortfall statistics quoted by the reference design doc
+        # (count / max / average overage, design.md:795-799; reference
+        # reporter.py:136-263 computes them from the same samples).
         return {
             "utilization": utilization,
             "samples": len(post),
             "overage_events": len(over),
             "max_overage": max((s.sum_has for s in over), default=0.0),
+            "avg_overage": (
+                sum(s.sum_has for s in over) / len(over) if over else 0.0
+            ),
         }
 
     def finalize(self) -> None:

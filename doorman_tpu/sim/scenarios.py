@@ -112,11 +112,28 @@ def scenario_seven(sim: Sim, reporter: Reporter) -> None:
         sim.scheduler.add_relative(delay, job.trigger_master_election)
         sim.varz.counter("mishap.lose_master").inc()
 
-    mishaps = [spike_client, trigger_election, lose_master]
-
     def random_mishap():
         sim.scheduler.add_relative(60, random_mishap)
-        sim.random.choice(mishaps)()
+        # The reference's weighted pick, reproduced exactly
+        # (scenario_seven.py:54-78): m = randint(0, 14) walked against
+        # the weight map {5: spike, 10: election, 15: lose_master} in
+        # Python 2 dict iteration order — which for these small-int
+        # keys is [10, 5, 15] (hash slots 2, 5, 7) — picking the entry
+        # once the cumulative weight reaches m. Effective distribution:
+        # election 1/15, spike 10/15, lose_master 4/15. Spikes dominate
+        # the reference's mishap hour; a uniform pick would inject ~5x
+        # more master elections and misstate recovery behavior.
+        m = sim.random.randint(0, 14)
+        n = 0
+        for weight, mishap in (
+            (10, trigger_election),
+            (5, spike_client),
+            (15, lose_master),
+        ):
+            if n >= m:
+                mishap()
+                return
+            n += weight
 
     sim.scheduler.add_absolute(60, random_mishap)
 
