@@ -29,7 +29,8 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict, Optional, Sequence, Tuple
+from collections import Counter
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -110,9 +111,7 @@ def make_multihost_mesh(
     # reshape below would put one host's chips into another host's
     # "dc" row and the host-local packing invariant silently breaks
     # (total-count divisibility alone cannot catch 3+5 over 2 hosts).
-    counts: Dict[int, int] = {}
-    for d in devices:
-        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    counts = Counter(d.process_index for d in devices)
     n_proc = len(counts)
     if len(set(counts.values())) > 1:
         raise ValueError(

@@ -200,7 +200,20 @@ class EtcdKV(LeaseKV):
                 return None
             lease_id = self._gw.lease_grant(ttl, timeout=t)
             state["lease"] = lease_id
-            won = self._gw.put_if_absent(key, value, lease_id, timeout=t)
+            try:
+                won = self._gw.put_if_absent(
+                    key, value, lease_id, timeout=t
+                )
+            except Exception:
+                # The put may have COMMITTED in etcd even though the
+                # response was lost: revoke so a lock nobody will renew
+                # cannot survive, then surface the failure.
+                try:
+                    self._gw.lease_revoke(lease_id, timeout=t)
+                except Exception:
+                    pass
+                state["lease"] = None
+                raise
             if state["abandoned"] or not won:
                 try:
                     self._gw.lease_revoke(lease_id, timeout=t)
