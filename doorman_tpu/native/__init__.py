@@ -114,8 +114,6 @@ def _declare(lib: ctypes.CDLL) -> None:
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.dm_clean_all.restype = ctypes.c_int64
     lib.dm_clean_all.argtypes = [ctypes.c_void_p, ctypes.c_double]
-    lib.dm_drain_dirty.restype = ctypes.c_int64
-    lib.dm_drain_dirty.argtypes = [ctypes.c_void_p, _I32P, ctypes.c_int64]
     lib.dm_drain_dirty2.restype = ctypes.c_int64
     lib.dm_drain_dirty2.argtypes = [ctypes.c_void_p, _I32P, u8p,
                                     ctypes.c_int64]
@@ -316,27 +314,12 @@ class StoreEngine:
             now = self._clock()
         return int(self._lib.dm_clean_all(self._ptr, now))
 
-    def drain_dirty(self) -> np.ndarray:
-        """Resources whose solver-visible inputs changed since the last
-        drain (engine rids, int32); clears the dirty flags."""
-        chunks = []
-        while True:
-            buf = np.empty(4096, np.int32)
-            n = int(
-                self._lib.dm_drain_dirty(
-                    self._ptr, buf.ctypes.data_as(_I32P), len(buf)
-                )
-            )
-            chunks.append(buf[:n])
-            if n < len(buf):
-                break
-        return np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
-
     def drain_dirty2(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Like drain_dirty, plus a parallel uint8 array flagging rows
-        that changed beyond wants (membership / has / subclients /
+        """Resources whose solver-visible inputs changed since the last
+        drain (engine rids, int32), plus a parallel uint8 array flagging
+        rows that changed beyond wants (membership / has / subclients /
         priority) — those need a full re-upload; unflagged rows changed
-        only in wants and ship just the wants lane."""
+        only in wants and ship just the wants lane. Clears both flags."""
         u8p = ctypes.POINTER(ctypes.c_uint8)
         rid_chunks, full_chunks = [], []
         while True:

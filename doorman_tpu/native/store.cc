@@ -20,6 +20,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <limits>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -53,6 +54,12 @@ struct ResourceStore {
   // while wants-only churn — the steady-state refresh traffic — ships
   // just the wants lane over the (slow) host<->device link.
   uint8_t dirty_full = 0;
+  // Lower bound on every lease's expiry: the per-tick engine-wide sweep
+  // skips the whole resource while now <= min_expiry, turning the O(all
+  // leases) scan into O(resources) in steady state. Writes only tighten
+  // it (removals and later re-stamps leave it loose); a sweep that does
+  // scan recomputes it exactly from the survivors.
+  double min_expiry = std::numeric_limits<double>::infinity();
 
   void remove_slot(size_t slot) {
     const Lease &l = leases[slot];
@@ -92,6 +99,26 @@ struct Engine {
   std::mutex mu;
 };
 
+// Shared expiry sweep: skipped entirely while nothing can be expired
+// (the min_expiry lower bound), else swap-removes lapsed leases and
+// recomputes the exact bound from the survivors.
+inline int64_t sweep_resource(ResourceStore &r, double now) {
+  if (!(now > r.min_expiry)) return 0;
+  int64_t removed = 0;
+  double new_min = std::numeric_limits<double>::infinity();
+  for (size_t slot = 0; slot < r.leases.size();) {
+    if (now > r.leases[slot].expiry) {
+      r.remove_slot(slot);  // swap-remove: re-check the same slot
+      ++removed;
+    } else {
+      if (r.leases[slot].expiry < new_min) new_min = r.leases[slot].expiry;
+      ++slot;
+    }
+  }
+  r.min_expiry = new_min;
+  return removed;
+}
+
 inline void mark_dirty(Engine *e, int32_t rid) {
   if (e->dirty_flags.size() < e->resources.size())
     e->dirty_flags.resize(e->resources.size(), 0);
@@ -118,6 +145,7 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
     ++r.version;
     r.dirty_full = 1;
     mark_dirty(e, rid);
+    if (fresh.expiry < r.min_expiry) r.min_expiry = fresh.expiry;
     return 0;
   }
   Lease &l = r.leases[it->second];
@@ -132,6 +160,7 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
   r.sum_wants += fresh.wants - l.wants;
   r.count += fresh.subclients - l.subclients;
   l = fresh;
+  if (fresh.expiry < r.min_expiry) r.min_expiry = fresh.expiry;
   return 1;
 }
 
@@ -214,15 +243,7 @@ int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
 int64_t dm_clean(Engine *e, int32_t rid, double now) {
   std::lock_guard<std::mutex> lock(e->mu);
   ResourceStore &r = e->resources[rid];
-  int64_t removed = 0;
-  for (size_t slot = 0; slot < r.leases.size();) {
-    if (now > r.leases[slot].expiry) {
-      r.remove_slot(slot);  // swap-remove: re-check the same slot
-      ++removed;
-    } else {
-      ++slot;
-    }
-  }
+  const int64_t removed = sweep_resource(r, now);
   if (removed) mark_dirty(e, rid);
   return removed;
 }
@@ -233,15 +254,7 @@ int64_t dm_clean_all(Engine *e, double now) {
   int64_t removed = 0;
   for (size_t rid = 0; rid < e->resources.size(); ++rid) {
     ResourceStore &r = e->resources[rid];
-    int64_t here = 0;
-    for (size_t slot = 0; slot < r.leases.size();) {
-      if (now > r.leases[slot].expiry) {
-        r.remove_slot(slot);
-        ++here;
-      } else {
-        ++slot;
-      }
-    }
+    const int64_t here = sweep_resource(r, now);
     if (here) mark_dirty(e, static_cast<int32_t>(rid));
     removed += here;
   }
@@ -249,22 +262,9 @@ int64_t dm_clean_all(Engine *e, double now) {
 }
 
 // Drain the dirty-resource list: writes up to `cap` dirty handles to
-// `out`, clears the flags, returns the count written.
-int64_t dm_drain_dirty(Engine *e, int32_t *out, int64_t cap) {
-  std::lock_guard<std::mutex> lock(e->mu);
-  const int64_t n =
-      std::min<int64_t>(cap, static_cast<int64_t>(e->dirty_list.size()));
-  for (int64_t i = 0; i < n; ++i) {
-    out[i] = e->dirty_list[i];
-    e->dirty_flags[e->dirty_list[i]] = 0;
-  }
-  e->dirty_list.erase(e->dirty_list.begin(), e->dirty_list.begin() + n);
-  return n;
-}
-
-// Like dm_drain_dirty, but also reports (and clears) each drained
-// resource's dirty_full flag: full_out[i]=1 means the row changed
-// beyond wants since its last drain and needs a full re-upload.
+// `out`, clears the flags (incl. dirty_full, reported in full_out:
+// full_out[i]=1 means the row changed beyond wants since its last
+// drain and needs a full re-upload), returns the count written.
 int64_t dm_drain_dirty2(Engine *e, int32_t *out, uint8_t *full_out,
                         int64_t cap) {
   std::lock_guard<std::mutex> lock(e->mu);
@@ -378,6 +378,7 @@ int64_t dm_bulk_refresh(Engine *e, const int32_t *rid, const int64_t *cid,
     l.wants = wants[i];
     l.expiry = expiry[i];
     l.refresh_interval = refresh[i];
+    if (expiry[i] < r.min_expiry) r.min_expiry = expiry[i];
     ++refreshed;
   }
   return refreshed;
@@ -415,6 +416,7 @@ int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
       l.expiry = expiry[i];
       l.refresh_interval = refresh[i];
     }
+    if (filled && expiry[i] < r.min_expiry) r.min_expiry = expiry[i];
     ++applied;
   }
   return applied;
@@ -545,6 +547,7 @@ int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
     }
     l.expiry = expiry[seg];
     l.refresh_interval = refresh[seg];
+    if (expiry[seg] < r.min_expiry) r.min_expiry = expiry[seg];
     applied_out[i] = 1;
     ++applied;
   }

@@ -13,7 +13,7 @@ design doc's recipe describes (doc/design.md "Multi-host"):
     axis follows process boundaries, so each host's chips form its own
     "dc" block (the intermediate-server role of the fused tree) and the
     per-dc partial aggregation never leaves the host's chips;
-  * `local_edge_block()` / `pack_process_edges()` — each host packs ONLY
+  * `pad_edge_block()` / `pack_process_edges()` — each host packs ONLY
     its own clients' edges (the leases its RPC frontends own) and the
     global sharded EdgeBatch is assembled with
     `jax.make_array_from_process_local_data`, so edge tables never cross

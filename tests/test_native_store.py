@@ -334,3 +334,32 @@ def test_drain_dirty2_classifies_wants_only_vs_full():
     sb.assign("y", 60, 5, 4.0, 11.0, 3)
     rids, full = engine.drain_dirty2()
     assert dict(zip(rids.tolist(), full.tolist()))[sb._rid] == 0
+
+
+def test_min_expiry_bound_sweeps_correctly():
+    """The engine's per-resource min-expiry bound makes the per-tick
+    sweep O(resources) in steady state; it must stay a valid LOWER
+    bound through re-stamps that loosen it (later expiry on the same
+    client) and recompute exactly whenever a scan happens."""
+    t = [0.0]
+    engine = native.StoreEngine(clock=lambda: t[0])
+    store = engine.store("r")
+    store.assign("a", 10.0, 5, 0.0, 1.0, 1)   # expires at 10
+    store.assign("b", 30.0, 5, 0.0, 1.0, 1)   # expires at 30
+
+    t[0] = 5.0
+    assert engine.clean_all() == 0            # bound (10) skips the scan
+    assert len(store) == 2
+
+    # Re-stamp "a" far into the future: the bound stays loosely at 10.
+    store.assign("a", 200.0, 5, 0.0, 1.0, 1)  # expires at 205
+
+    t[0] = 50.0
+    assert engine.clean_all() == 1            # scans: only "b" lapsed
+    assert store.has_client("a") and not store.has_client("b")
+
+    t[0] = 150.0
+    assert engine.clean_all() == 0            # recomputed bound skips
+    t[0] = 250.0
+    assert engine.clean_all() == 1            # "a" finally lapses
+    assert len(store) == 0
