@@ -17,7 +17,9 @@ from doorman_tpu.parallel.multihost import (  # noqa: F401
 )
 from doorman_tpu.parallel.sharded import (  # noqa: F401
     make_sharded_dense_solver,
+    make_sharded_priority_solver,
     make_sharded_solver,
     shard_dense,
     shard_edges,
+    shard_priority,
 )

@@ -144,40 +144,114 @@ def make_sharded_dense_solver(mesh: Mesh, *, donate: bool = False):
     return solve
 
 
+def make_sharded_priority_solver(
+    mesh: Mesh, num_bands: int = 4, *, donate: bool = False
+):
+    """Resource-axis sharded PRIORITY_BANDS solve with capacity groups.
+
+    Rows (resources) shard across every mesh axis like the dense solve,
+    but group caps couple resources ACROSS shards: each device computes
+    its local per-group usage and a psum over the mesh replicates the
+    totals, so the theta bisection runs identically everywhere — one
+    [G]-sized collective per bisection evaluation is the entire
+    cross-device traffic (the banded water-fill itself stays row-local).
+    Place inputs with `shard_priority`; group_cap is replicated."""
+    from doorman_tpu.solver.priority import PriorityBatch, solve_priority
+
+    axes = tuple(mesh.axis_names)
+    row = P(axes)
+    rowk = P(axes, None)
+    rep = P()
+
+    def shard_fn(wants, weights, band, active, cap, group, group_cap):
+        return solve_priority(
+            PriorityBatch(
+                wants=wants, weights=weights, band=band, active=active,
+                capacity=cap, group=group, group_cap=group_cap,
+            ),
+            num_bands=num_bands,
+            combine_axes=axes,
+        )
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(rowk, rowk, rowk, rowk, row, row, rep),
+        out_specs=rowk,
+    )
+
+    @partial(jax.jit, donate_argnums=tuple(range(4)) if donate else ())
+    def solve_parts(wants, weights, band, active, cap, group, group_cap):
+        return mapped(wants, weights, band, active, cap, group, group_cap)
+
+    def solve(batch) -> jax.Array:
+        return solve_parts(
+            batch.wants, batch.weights, batch.band, batch.active,
+            batch.capacity, batch.group, batch.group_cap,
+        )
+
+    return solve
+
+
+def _row_placer(mesh: Mesh, num_rows: int):
+    """Shared pad-and-place machinery for the row-sharded batch layouts
+    (shard_dense / shard_priority): rows pad up to a multiple of the
+    device count with `fill`, then land sharded over all mesh axes
+    (spec P(axes, ...) per trailing rank) or replicated (spec=None)."""
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    pad = (-num_rows) % n_dev
+    axes = tuple(mesh.axis_names)
+
+    def put(arr, *, sharded_rows: bool = True, fill=0):
+        arr = np.asarray(arr)
+        if not sharded_rows:
+            return jax.device_put(arr, NamedSharding(mesh, P()))
+        if pad:
+            arr = np.concatenate(
+                [arr, np.full((pad,) + arr.shape[1:], fill, arr.dtype)]
+            )
+        spec = P(axes, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return put
+
+
+def shard_priority(mesh: Mesh, batch):
+    """Place a PriorityBatch on the mesh: row (resource) axis sharded
+    over all mesh axes and padded with inactive, ungrouped rows up to a
+    multiple of the device count; group_cap replicated."""
+    from doorman_tpu.solver.priority import PriorityBatch
+
+    put = _row_placer(mesh, int(np.asarray(batch.capacity).shape[0]))
+    return PriorityBatch(
+        wants=put(batch.wants),
+        weights=put(batch.weights),
+        band=put(batch.band),
+        active=put(batch.active),
+        capacity=put(batch.capacity),
+        # Padding rows are ungrouped (-1): they contribute nothing to
+        # any group's usage.
+        group=put(batch.group, fill=-1),
+        group_cap=put(batch.group_cap, sharded_rows=False),
+    )
+
+
 def shard_dense(mesh: Mesh, batch):
     """Place a DenseBatch on the mesh: row (resource) axis sharded over
     all mesh axes, padded with inactive rows up to a multiple of the
     device count (the dense analog of shard_edges)."""
     from doorman_tpu.solver.dense import DenseBatch
 
-    n_dev = int(np.prod(list(mesh.shape.values())))
-    R = int(np.asarray(batch.capacity).shape[0])
-    pad = (-R) % n_dev
-
-    def rows(arr):
-        arr = np.asarray(arr)
-        if pad:
-            arr = np.concatenate(
-                [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)]
-            )
-        return arr
-
-    axes = tuple(mesh.axis_names)
-    put_rowk = lambda a: jax.device_put(
-        rows(a), NamedSharding(mesh, P(axes, None))
-    )
-    put_row = lambda a: jax.device_put(
-        rows(a), NamedSharding(mesh, P(axes))
-    )
+    put = _row_placer(mesh, int(np.asarray(batch.capacity).shape[0]))
     return DenseBatch(
-        wants=put_rowk(batch.wants),
-        has=put_rowk(batch.has),
-        subclients=put_rowk(batch.subclients),
-        active=put_rowk(batch.active),
-        capacity=put_row(batch.capacity),
-        algo_kind=put_row(batch.algo_kind),
-        learning=put_row(batch.learning),
-        static_capacity=put_row(batch.static_capacity),
+        wants=put(batch.wants),
+        has=put(batch.has),
+        subclients=put(batch.subclients),
+        active=put(batch.active),
+        capacity=put(batch.capacity),
+        algo_kind=put(batch.algo_kind),
+        learning=put(batch.learning),
+        static_capacity=put(batch.static_capacity),
     )
 
 

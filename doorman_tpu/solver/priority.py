@@ -91,9 +91,14 @@ def _alloc_banded(
     return gets
 
 
-@functools.partial(jax.jit, static_argnames=("num_bands", "use_pallas"))
+@functools.partial(
+    jax.jit, static_argnames=("num_bands", "use_pallas", "combine_axes")
+)
 def solve_priority(
-    batch: PriorityBatch, num_bands: int = 4, use_pallas: bool = False
+    batch: PriorityBatch,
+    num_bands: int = 4,
+    use_pallas: bool = False,
+    combine_axes: "tuple[str, ...] | None" = None,
 ) -> jax.Array:
     """Grants [R, K]; matches algorithms.priority.grouped_priority_alloc.
 
@@ -101,7 +106,14 @@ def solve_priority(
     edges with band >= num_bands are never served). `use_pallas` runs the
     banded water-fill as the fused VMEM kernel (TPU only) — the group-cap
     bisection evaluates it ~THETA_ITERS times, so the fusion's
-    one-HBM-pass-per-evaluation matters."""
+    one-HBM-pass-per-evaluation matters. `combine_axes` (when running
+    inside shard_map with the resource axis sharded) names the mesh axes
+    to psum the per-group usage vector over — group caps are the one
+    cross-resource coupling, so that [G]-sized psum is the ONLY
+    collective the sharded solve needs; the bisection then runs
+    identically on every device from the replicated totals
+    (parallel.sharded.make_sharded_priority_solver). A hashable tuple
+    rather than a callable so repeated calls hit the jit cache."""
     dtype = batch.wants.dtype
     wants = jnp.where(batch.active, batch.wants, 0.0).astype(dtype)
     weights = jnp.where(batch.active, batch.weights, 0.0).astype(dtype)
@@ -133,9 +145,12 @@ def solve_priority(
         theta_r = jnp.where(grouped, theta_g[gidx], 1.0)
         gets = alloc(batch.capacity * theta_r)
         per_resource = gets.sum(axis=1)
-        return jax.ops.segment_sum(
+        usage = jax.ops.segment_sum(
             jnp.where(grouped, per_resource, 0.0), gidx, num_segments=G
         )
+        if combine_axes:
+            usage = jax.lax.psum(usage, combine_axes)
+        return usage
 
     def body(_, carry):
         lo, hi = carry
