@@ -76,6 +76,14 @@ class LeaseKV(abc.ABC):
     async def get(self, key: str) -> Optional[str]:
         """Current live value of the key, or None."""
 
+    async def wait_for_change(self, key: str, timeout: float) -> None:
+        """Block until the key (probably) changed, or `timeout`. The
+        default is a plain sleep (polling); KVs with a real watch (etcd)
+        override it so the election's current-master broadcast follows
+        changes instantly, like the reference's watcher goroutine
+        (election.go:141-170)."""
+        await asyncio.sleep(timeout)
+
 
 class InMemoryKV(LeaseKV):
     """Process-local LeaseKV for tests and single-process multi-server
@@ -140,6 +148,7 @@ class EtcdKV(LeaseKV):
     def __init__(self, endpoints: list[str]):
         self._gw = EtcdGateway(endpoints)
         self._leases: Dict[str, int] = {}  # lock key -> held lease id
+        self._fast_watches = 0  # consecutive instant watch returns
 
     async def _call(self, fn):
         try:
@@ -281,6 +290,42 @@ class EtcdKV(LeaseKV):
         )
         return value.decode() if value is not None else None
 
+    async def wait_for_change(self, key, timeout) -> None:
+        """Real etcd watch: returns as soon as the lock key changes, so
+        mastership broadcasts propagate in RPC time rather than a poll
+        interval. Falls back to a sleep when the watch cannot be
+        established (partition), and rate-limits consecutive instant
+        returns — an endpoint whose /v3/watch answers immediately with
+        an error body or a closed stream reports "success" per the
+        gateway's lenient contract, and without a floor the watch loop
+        would hammer etcd back-to-back (the polling default this
+        replaced was bounded to one get per interval)."""
+        start = time.monotonic()
+        ok = False
+        try:
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self._gw.wait_for_change(key, timeout=timeout),
+            )
+        except Exception:
+            ok = False
+        if not ok:
+            self._fast_watches = 0
+            await asyncio.sleep(min(timeout, 1.0))
+            return
+        if time.monotonic() - start < 0.05:
+            # A genuine change can return this fast once or twice in a
+            # row (re-election storm); only a degenerate watch does so
+            # indefinitely. Escalate to the full poll interval then.
+            self._fast_watches += 1
+            await asyncio.sleep(
+                min(timeout, 1.0)
+                if self._fast_watches >= 5
+                else 0.05
+            )
+        else:
+            self._fast_watches = 0
+
 
 class KVElection(Election):
     """TTL-lock election over a LeaseKV (reference election.go:89-172):
@@ -332,4 +377,9 @@ class KVElection(Election):
             if value != last:
                 last = value
                 await on_current(value)
-            await asyncio.sleep(min(1.0, self._ttl / 3))
+            # A real watch (etcd) returns the moment the lock changes;
+            # the plain-KV default sleeps the poll interval (reference
+            # watcher goroutine, election.go:141-170).
+            await self._kv.wait_for_change(
+                self._lock, min(1.0, self._ttl / 3)
+            )

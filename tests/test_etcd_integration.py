@@ -182,3 +182,47 @@ def test_election_reacquire_after_standby_departs(fake):
         await el.stop()
 
     asyncio.run(body())
+
+
+def test_degenerate_watch_does_not_busy_loop():
+    """An endpoint whose /v3/watch answers instantly with a non-stream
+    body (error page, non-streaming proxy) reports success under the
+    gateway's lenient watch contract; the election's wait_for_change
+    must still pace its cycles instead of hammering etcd back-to-back."""
+    import json as _json
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    class InstantHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.0"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = _json.dumps({"error": "watch unsupported"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), InstantHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+
+    async def body():
+        kv = EtcdKV([addr])
+        t0 = time.monotonic()
+        for _ in range(8):
+            await kv.wait_for_change("/lock", 0.3)
+        return time.monotonic() - t0
+
+    elapsed = asyncio.run(body())
+    httpd.shutdown()
+    httpd.server_close()
+    # 8 degenerate cycles: a busy loop would finish in ~milliseconds;
+    # the pacing floor (0.05s, escalating to the poll interval after 5
+    # consecutive instant returns) keeps the rate bounded.
+    assert elapsed >= 0.5, f"watch cycles not paced: {elapsed:.3f}s"
