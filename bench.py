@@ -232,8 +232,10 @@ def bench_server_tick() -> None:
                  full-table solve, start the grant download for the
                  delivery set (dirty rows + the rotation slice that
                  rides the 16s refresh cadence);
-      collect  — download lands, one dm_apply_dense C call writes
-                 grants + fresh expiries back.
+      collect  — download lands, one dm_apply_dense C call writes the
+                 grants back (lease expiry stays client-driven: the 5%
+                 churn per tick re-stamps its leases the way RPC
+                 refreshes would).
 
     PIPELINE_DEPTH_SERVER ticks stay in flight, as in the server's
     tick loop. Steady state: warm-up ticks compile both bucket shapes,

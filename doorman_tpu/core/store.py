@@ -98,6 +98,26 @@ class LeaseStore:
         self._leases[client] = lease
         return lease
 
+    def regrant(self, client: str, has: float) -> None:
+        """Update only the granted capacity of an existing lease — the
+        batched tick's write-back. Expiry and refresh are NOT touched:
+        they advance only when the client itself refreshes (reference
+        semantics, store.go:153-181 + Decide stamping the requester
+        only), so a client that stops refreshing expires after one
+        lease length even while the server stays busy."""
+        old = self._leases.get(client)
+        if old is None:
+            return  # released mid-solve
+        self._sum_has += has - old.has
+        self._leases[client] = Lease(
+            expiry=old.expiry,
+            refresh_interval=old.refresh_interval,
+            has=has,
+            wants=old.wants,
+            subclients=old.subclients,
+            priority=old.priority,
+        )
+
     def release(self, client: str) -> None:
         lease = self._leases.pop(client, None)
         if lease is None:

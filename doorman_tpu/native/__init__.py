@@ -107,7 +107,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_apply.restype = ctypes.c_int64
     lib.dm_apply.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int32, _I32P, _I64P, _F64P,
-        ctypes.c_int64, _F64P, _F64P, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_uint8),
     ]
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -124,7 +124,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_apply_dense.restype = ctypes.c_int64
     lib.dm_apply_dense.argtypes = [
         ctypes.c_void_p, _I32P, ctypes.c_int64, ctypes.c_int64,
-        _F64P, _F64P, _F64P, u8p, u64p,
+        _F64P, u8p, u64p,
     ]
     lib.dm_band_aggregates.restype = ctypes.c_int64
     lib.dm_band_aggregates.argtypes = [
@@ -365,18 +365,15 @@ class StoreEngine:
         self,
         rids: np.ndarray,  # [n] engine resource handles
         grants: np.ndarray,  # [n, K] in upload-time slot order
-        expiry: np.ndarray,  # [n]
-        refresh: np.ndarray,  # [n]
         keep_has: np.ndarray,  # [n] uint8
         expected_versions: np.ndarray,  # [n] uint64
     ) -> int:
-        """Dense grant write-back; rows whose membership epoch moved
-        since upload are skipped (they re-solve next tick). Returns the
-        number of rows applied."""
+        """Dense grant write-back (grants ONLY — expiry/refresh are
+        client-driven, see dm_apply_dense); rows whose membership epoch
+        moved since upload are skipped (they re-solve next tick).
+        Returns the number of rows applied."""
         rids = np.ascontiguousarray(rids, np.int32)
         grants = np.ascontiguousarray(grants, np.float64)
-        expiry = np.ascontiguousarray(expiry, np.float64)
-        refresh = np.ascontiguousarray(refresh, np.float64)
         keep_has = np.ascontiguousarray(keep_has, np.uint8)
         expected_versions = np.ascontiguousarray(
             expected_versions, np.uint64
@@ -387,8 +384,6 @@ class StoreEngine:
             self._lib.dm_apply_dense(
                 self._ptr, rids.ctypes.data_as(_I32P), len(rids),
                 grants.shape[1], grants.ctypes.data_as(_F64P),
-                expiry.ctypes.data_as(_F64P),
-                refresh.ctypes.data_as(_F64P),
                 keep_has.ctypes.data_as(u8p),
                 expected_versions.ctypes.data_as(u64p),
             )
@@ -400,20 +395,16 @@ class StoreEngine:
         ridx: np.ndarray,  # [E] segment per edge
         cid: np.ndarray,  # [E]
         gets: np.ndarray,  # [E]
-        expiry: np.ndarray,  # [n_seg] absolute expiry stamps
-        refresh: np.ndarray,  # [n_seg]
-        keep_has: "np.ndarray | None" = None,  # [n_seg] bool: refresh only
+        keep_has: "np.ndarray | None" = None,  # [n_seg] bool
     ) -> np.ndarray:
-        """Bulk grant write-back; returns a bool mask of edges applied
-        (False: client released or resource gone mid-solve). Segments
-        flagged in keep_has refresh expiries but leave has untouched
-        (learning mode)."""
+        """Bulk grant write-back (grants ONLY — expiry/refresh are
+        client-driven, see dm_apply); returns a bool mask of edges
+        applied (False: client released or resource gone mid-solve).
+        Segments flagged in keep_has leave has untouched (learning)."""
         order_rids = np.ascontiguousarray(order_rids, np.int32)
         ridx = np.ascontiguousarray(ridx, np.int32)
         cid = np.ascontiguousarray(cid, np.int64)
         gets = np.ascontiguousarray(gets, np.float64)
-        expiry = np.ascontiguousarray(expiry, np.float64)
-        refresh = np.ascontiguousarray(refresh, np.float64)
         if keep_has is None:
             keep_has = np.zeros(len(order_rids), np.uint8)
         keep_has = np.ascontiguousarray(keep_has, np.uint8)
@@ -424,7 +415,6 @@ class StoreEngine:
             order_rids.ctypes.data_as(_I32P), len(order_rids),
             ridx.ctypes.data_as(_I32P), cid.ctypes.data_as(_I64P),
             gets.ctypes.data_as(_F64P), len(ridx),
-            expiry.ctypes.data_as(_F64P), refresh.ctypes.data_as(_F64P),
             keep_has.ctypes.data_as(u8p),
             applied.ctypes.data_as(u8p),
         )
@@ -505,6 +495,18 @@ class NativeLeaseStore:
         return Lease(expiry=expiry, refresh_interval=refresh_interval,
                      has=has, wants=wants, subclients=subclients,
                      priority=priority)
+
+    def regrant(self, client: str, has: float) -> None:
+        """Update only the granted capacity of an existing lease (see
+        core.store.LeaseStore.regrant); expiry/refresh stay put."""
+        old = self.get(client)
+        if old is ZERO_LEASE:
+            return
+        self._lib.dm_assign(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            old.expiry, old.refresh_interval, has, old.wants,
+            old.subclients, old.priority,
+        )
 
     def release(self, client: str) -> None:
         self._lib.dm_release(

@@ -389,11 +389,14 @@ int64_t dm_bulk_refresh(Engine *e, const int32_t *rid, const int64_t *cid,
 // resource's membership epoch still equals expected_version[i] — rows
 // that changed while the solve was in flight are skipped (their change
 // dirtied the row, so the next tick re-solves and re-delivers them).
-// keep_has[i] != 0 stamps expiry/refresh but leaves has untouched
-// (learning-mode replay). Returns the number of rows applied.
+// Writes ONLY the granted capacity: lease expiry/refresh advance when
+// the client itself refreshes (the decide path), never on delivery —
+// otherwise a crashed client's lease would be renewed forever by the
+// tick and its capacity never reclaimed (reference semantics: Decide
+// stamps the requester only, store.go:153-181). keep_has[i] != 0
+// preserves even has (learning-mode replay). Returns rows applied.
 int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
                        int64_t K, const double *grants,
-                       const double *expiry, const double *refresh,
                        const uint8_t *keep_has,
                        const uint64_t *expected_version) {
   std::lock_guard<std::mutex> lock(e->mu);
@@ -404,19 +407,16 @@ int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
       continue;
     ResourceStore &r = e->resources[rids[i]];
     if (r.version != expected_version[i]) continue;
-    const double *g = grants + i * K;
-    const int64_t filled =
-        std::min<int64_t>(K, static_cast<int64_t>(r.leases.size()));
-    for (int64_t j = 0; j < filled; ++j) {
-      Lease &l = r.leases[j];
-      if (!keep_has[i]) {
+    if (!keep_has[i]) {
+      const double *g = grants + i * K;
+      const int64_t filled =
+          std::min<int64_t>(K, static_cast<int64_t>(r.leases.size()));
+      for (int64_t j = 0; j < filled; ++j) {
+        Lease &l = r.leases[j];
         r.sum_has += g[j] - l.has;
         l.has = g[j];
       }
-      l.expiry = expiry[i];
-      l.refresh_interval = refresh[i];
     }
-    if (filled && expiry[i] < r.min_expiry) r.min_expiry = expiry[i];
     ++applied;
   }
   return applied;
@@ -518,18 +518,18 @@ int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
 }
 
 // Bulk grant write-back after a solve: for each edge, if the client
-// still holds a lease, set has=gets and stamp the segment's fresh
-// expiry/refresh; wants/subclients/priority keep their CURRENT store
-// values so demand that changed while the solve was in flight is
-// preserved (same semantics as BatchSolver.apply). order[seg] < 0 skips
-// that segment (its resource vanished mid-solve); keep_has[seg] != 0
-// refreshes the lease but leaves has untouched (learning-mode resources
-// replay the reported grant). applied_out[i] is 1 where the edge was
-// written. Returns the number applied.
+// still holds a lease, set has=gets; everything else — expiry, refresh,
+// wants, subclients, priority — keeps its CURRENT store value, so
+// demand that changed while the solve was in flight is preserved and
+// leases expire on the client's own refresh schedule (same grants-only
+// semantics as dm_apply_dense). order[seg] < 0 skips that segment (its
+// resource vanished mid-solve); keep_has[seg] != 0 leaves even has
+// untouched (learning-mode resources replay the reported grant).
+// applied_out[i] is 1 where the edge was written. Returns the number
+// applied.
 int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
                  const int32_t *ridx, const int64_t *cid,
                  const double *gets, int64_t n_edges,
-                 const double *expiry, const double *refresh,
                  const uint8_t *keep_has, uint8_t *applied_out) {
   std::lock_guard<std::mutex> lock(e->mu);
   int64_t applied = 0;
@@ -545,9 +545,6 @@ int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
       r.sum_has += gets[i] - l.has;
       l.has = gets[i];
     }
-    l.expiry = expiry[seg];
-    l.refresh_interval = refresh[seg];
-    if (expiry[seg] < r.min_expiry) r.min_expiry = expiry[seg];
     applied_out[i] = 1;
     ++applied;
   }

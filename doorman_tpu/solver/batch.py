@@ -490,10 +490,13 @@ class BatchSolver:
         *,
         return_grants: bool = True,
     ) -> Dict[str, Dict[str, float]]:
-        """Phase 3 (host, store-owning thread): write grants back with
-        fresh lease expiries. Demand that changed while the solve was in
-        flight is preserved (wants/subclients are re-read from the store),
-        and clients released mid-solve stay released.
+        """Phase 3 (host, store-owning thread): write grants back.
+        Grants ONLY — lease expiry/refresh advance when each client
+        itself refreshes (the decide path), never on delivery, so a
+        client that stops refreshing expires after one lease length
+        even while the server stays busy (reference semantics). Demand
+        that changed while the solve was in flight is preserved, and
+        clients released mid-solve stay released.
 
         `return_grants=False` skips materializing the per-client grant
         map — the tick loop only needs the store side effects, and at
@@ -516,22 +519,15 @@ class BatchSolver:
                 res = by_id.get(resource_id)
                 if res is None or not res.store.has_client(client_id):
                     continue
-                algo = res.template.algorithm
-                old = res.store.get(client_id)
                 if resource_id in learn_ids:
                     # Learning mode replays the client's reported has; use
                     # the store's live value, not the snapshot-stale copy
                     # the solve saw (a report landing mid-solve wins).
-                    grant = old.has
-                res.store.assign(
-                    client_id,
-                    float(algo.lease_length),
-                    float(algo.refresh_interval),
-                    grant,
-                    old.wants,
-                    old.subclients,
-                    priority=old.priority,
-                )
+                    grant = res.store.get(client_id).has
+                # Grants only: expiry/refresh advance when the client
+                # itself refreshes, never on delivery (reference
+                # semantics — a dead client must expire on schedule).
+                res.store.regrant(client_id, grant)
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
         self._apply_priority_part(by_id, snap, out, return_grants)
@@ -558,23 +554,15 @@ class BatchSolver:
             res = by_id.get(resource_id)
             if res is None:
                 continue
-            algo = res.template.algorithm
             for j, client_id in enumerate(part.clients[i]):
                 if not res.store.has_client(client_id):
                     continue
-                old = res.store.get(client_id)
                 grant = (
-                    old.has if part.learning[i] else float(part.gets[i, j])
+                    res.store.get(client_id).has
+                    if part.learning[i]
+                    else float(part.gets[i, j])
                 )
-                res.store.assign(
-                    client_id,
-                    float(algo.lease_length),
-                    float(algo.refresh_interval),
-                    grant,
-                    old.wants,
-                    old.subclients,
-                    priority=old.priority,
-                )
+                res.store.regrant(client_id, grant)
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
 
@@ -585,14 +573,12 @@ class BatchSolver:
         out: Dict[str, Dict[str, float]],
         return_grants: bool,
     ) -> None:
-        """One dm_apply call writes the priority part back; learning-mode
-        segments refresh expiries but keep the reported has."""
+        """One dm_apply call writes the priority part back (grants only;
+        expiry/refresh are client-driven); learning-mode segments keep
+        the reported has."""
         engine = part.engine
-        now = self._clock()
         n_seg = len(part.resource_ids)
         order = np.full(n_seg, -1, np.int32)
-        expiry = np.zeros(n_seg, np.float64)
-        refresh = np.zeros(n_seg, np.float64)
         keep_has = np.zeros(n_seg, np.uint8)
         for i, resource_id in enumerate(part.resource_ids):
             res = by_id.get(resource_id)
@@ -600,17 +586,12 @@ class BatchSolver:
                 continue
             if getattr(res.store, "_engine", None) is not engine:
                 continue
-            algo = res.template.algorithm
             order[i] = res.store._rid
-            expiry[i] = now + float(algo.lease_length)
-            refresh[i] = float(algo.refresh_interval)
             keep_has[i] = 1 if part.learning[i] else 0
         flat = np.asarray(
             part.gets[part.ridx, part.pos], np.float64
         )
-        applied = engine.apply(
-            order, part.ridx, part.cids, flat, expiry, refresh, keep_has
-        )
+        applied = engine.apply(order, part.ridx, part.cids, flat, keep_has)
         if not return_grants:
             return
         _rebuild_grant_map(
@@ -630,11 +611,8 @@ class BatchSolver:
         skip/preserve semantics as the Python loop); the returned grant
         map is rebuilt from the applied mask."""
         engine = snap.engine
-        now = self._clock()
         n_seg = len(snap.resource_ids)
         order = np.full(n_seg, -1, np.int32)
-        expiry = np.zeros(n_seg, np.float64)
-        refresh = np.zeros(n_seg, np.float64)
         keep_has = np.zeros(n_seg, np.uint8)
         for i, resource_id in enumerate(snap.resource_ids):
             res = by_id.get(resource_id)
@@ -642,19 +620,14 @@ class BatchSolver:
                 continue  # resource vanished mid-solve: skip its edges
             if getattr(res.store, "_engine", None) is not engine:
                 continue  # store replaced mid-solve (mastership reset)
-            algo = res.template.algorithm
             order[i] = res.store._rid
-            expiry[i] = now + float(algo.lease_length)
-            refresh[i] = float(algo.refresh_interval)
             if snap.learning and snap.learning[i]:
-                # Learning mode: refresh the expiry but keep the store's
-                # live has (a client report landing mid-solve wins over
-                # the snapshot-stale replay the solve produced).
+                # Learning mode: keep the store's live has (a client
+                # report landing mid-solve wins over the snapshot-stale
+                # replay the solve produced).
                 keep_has[i] = 1
         flat = np.asarray(gets[: snap.num_edges], np.float64)
-        applied = engine.apply(
-            order, snap.ridx, snap.cids, flat, expiry, refresh, keep_has
-        )
+        applied = engine.apply(order, snap.ridx, snap.cids, flat, keep_has)
         out: Dict[str, Dict[str, float]] = {}
         if not return_grants:
             return out

@@ -27,6 +27,11 @@ moves only what changed:
             go/server/doorman/server.go:732-817). `rotate_ticks` derives
             from min(refresh_interval)/tick_interval unless pinned.
 
+Idle servers cost no device work: once two full rotations have
+delivered with no store or config changes, the store provably equals
+the device fixpoint and ticks return immediately until something
+changes.
+
 Write-back safety: each row records the resource's membership epoch at
 upload; `dm_apply_dense` skips rows whose epoch moved while the solve
 was in flight (the change dirtied the row, so the next tick re-solves
@@ -72,14 +77,13 @@ class ResidentOverflow(RuntimeError):
 @dataclass
 class TickHandle:
     """One in-flight tick: the device output plus everything collect()
-    needs to write it back."""
+    needs to write it back. out=None marks an idle tick (nothing to
+    download or apply)."""
 
     out: object  # device array [Sb, kfill], download pending
     sel_rows: np.ndarray  # [n_sel] row indices (unique)
     rids: np.ndarray  # [n_sel] engine resource handles
     versions: np.ndarray  # [n_sel] membership epochs at upload
-    expiry: np.ndarray  # [n_sel] absolute stamps
-    refresh: np.ndarray  # [n_sel]
     keep_has: np.ndarray  # [n_sel] uint8 (learning rows)
     n_sel: int = 0
     dispatched_at: float = 0.0
@@ -132,7 +136,9 @@ class ResidentDenseSolver:
         # capacity in the store; correctness wins by default.
         self._out_dtype = download_dtype or self._dtype
         self.ticks = 0
+        self.idle_ticks = 0  # ticks served by the idle fast path
         self.last_tick_seconds = 0.0
+        self._quiet_ticks = 0
         # Per-phase wall-time accumulators (seconds) for the perf
         # breakdown; bench.py reports them per tick. Keys: sweep, drain,
         # pack, config, upload, launch, download, apply.
@@ -154,7 +160,7 @@ class ResidentDenseSolver:
         # Per-row config, host mirror + device handle.
         self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
         self._cap_d = self._kind_d = self._statc_d = self._learn_d = None
-        self._lease_len = self._refresh = None
+        self._refresh = None
         self._cap_raw = self._learn_end = self._parent_exp = None
         self._config_epoch = -1
 
@@ -185,7 +191,6 @@ class ResidentDenseSolver:
         cap = np.zeros(Rp, dtype)
         kind = np.zeros(Rp, np.int32)
         statc = np.zeros(Rp, dtype)
-        lease_len = np.full(Rp, 1.0, np.float64)
         refresh = np.full(Rp, 1.0, np.float64)
         learn_end = np.zeros(Rp, np.float64)
         parent_exp = np.full(Rp, np.inf, np.float64)
@@ -194,7 +199,6 @@ class ResidentDenseSolver:
             cap[i] = tpl.capacity
             kind[i] = algo_kind_for(tpl)
             statc[i] = static_param(tpl)
-            lease_len[i] = float(tpl.algorithm.lease_length)
             refresh[i] = float(tpl.algorithm.refresh_interval)
             learn_end[i] = r.learning_mode_end
             if r.parent_expiry is not None:
@@ -202,7 +206,7 @@ class ResidentDenseSolver:
         self._cap_raw = cap
         self._learn_end = learn_end
         self._parent_exp = parent_exp
-        self._lease_len, self._refresh = lease_len, refresh
+        self._refresh = refresh
         if self._rotate_override is None and self._tick_interval and rows:
             # Delivery must cover the whole table at least once per
             # refresh interval, else a client can refresh against a
@@ -419,6 +423,43 @@ class ResidentDenseSolver:
             dirty_rows = np.zeros(0, np.int64)
             dirty_full = np.zeros(0, bool)
         lap("drain")
+        config_changed = self._refresh_config(res_list, config_epoch, now)
+        lap("config")
+
+        # Idle fast path: with no store changes and no config movement
+        # for TWO full rotations, the store of record provably holds the
+        # device fixpoint, and an idle server then costs NO device work
+        # per tick instead of a full solve + delivery forever. Two
+        # rotations, not one: the `has` chain is an iteration — a row
+        # delivered early in the FIRST quiet rotation can carry a
+        # pre-convergence value (proportional lanes redistribute freed
+        # capacity over ~2 ticks) — while every delivery in the second
+        # rotation is at least a full rotation of iterations past the
+        # last change, far beyond any lane's convergence depth. Any
+        # store write, expiry sweep removal (it dirties the row), config
+        # epoch bump, or time-driven capacity/learning flip resumes real
+        # ticks on the very next dispatch.
+        quiet = (
+            len(dirty_rows) == 0
+            and not self._just_rebuilt
+            and config_changed is not None
+            and len(config_changed) == 0
+        )
+        if quiet:
+            self._quiet_ticks += 1
+            if self._quiet_ticks > max(2 * self.rotate_ticks,
+                                       self.rotate_ticks + 3):
+                return TickHandle(
+                    out=None,
+                    sel_rows=np.zeros(0, np.int64),
+                    rids=np.zeros(0, np.int32),
+                    versions=np.zeros(0, np.uint64),
+                    keep_has=np.zeros(0, np.uint8),
+                    n_sel=0,
+                    dispatched_at=now,
+                )
+        else:
+            self._quiet_ticks = 0
         if len(dirty_rows) == 0:
             # No demand changes: scatter the reserved zero padding row.
             dirty_rows = np.asarray([self._R], np.int64)
@@ -453,8 +494,6 @@ class ResidentDenseSolver:
         is_full |= versions != self._uploaded_versions[order]
         self._uploaded_versions[order] = versions
         lap("pack")
-        config_changed = self._refresh_config(res_list, config_epoch, now)
-        lap("config")
 
         # Delivery set: every dirty row + every config-changed row + the
         # rotation slice — or every row on a rebuild/epoch-moved tick
@@ -528,8 +567,6 @@ class ResidentDenseSolver:
             sel_rows=sel,
             rids=self._rids[sel],
             versions=self._uploaded_versions[sel],
-            expiry=now + self._lease_len[sel],
-            refresh=self._refresh[sel],
             keep_has=self._learn_h[sel].astype(np.uint8),
             n_sel=n_sel,
             dispatched_at=now,
@@ -546,6 +583,13 @@ class ResidentDenseSolver:
         if handle.collected:
             return 0
         handle.collected = True
+        if handle.out is None:
+            # Idle tick: the store already holds the fixpoint; this
+            # still counts as an applied tick (the table is current).
+            self.ticks += 1
+            self.idle_ticks += 1
+            self.last_tick_seconds = self._clock() - handle.dispatched_at
+            return 0
         t0 = time.perf_counter()
         gets = chunked_device_get(handle.out)
         gets = np.asarray(gets, np.float64)[: handle.n_sel]
@@ -556,8 +600,6 @@ class ResidentDenseSolver:
         applied = self._engine.apply_dense(
             handle.rids,
             gets,
-            handle.expiry,
-            handle.refresh,
             handle.keep_has,
             handle.versions,
         )

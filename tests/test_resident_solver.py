@@ -377,3 +377,88 @@ def test_expiry_sweep_and_store_consistency():
         assert len(res.store) == 0
         assert res.store.sum_has == 0.0
         assert res.store.sum_wants == 0.0
+
+
+def test_idle_fast_path_skips_device_work_until_something_changes():
+    """Once a full rotation delivered with no changes, ticks cost no
+    device work; any store write, capacity flip, or expiry resumes real
+    solves and the change still lands in the store."""
+    t = [100.0]
+    clock = lambda: t[0]
+    engine, resources = make_prop_world(clock, n_res=6)
+    solver = ResidentDenseSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=2
+    )
+    # Converge, then run two full quiet rotations (rotate_ticks=2
+    # means idling starts on the 6th quiet tick).
+    for _ in range(9):
+        solver.step(resources)
+        t[0] += 1.0
+    assert solver.idle_ticks > 0, "idle path never engaged"
+    idle_before = solver.idle_ticks
+    ticks_before = solver.ticks
+    for _ in range(3):
+        solver.step(resources)
+        t[0] += 1.0
+    assert solver.idle_ticks == idle_before + 3  # all skipped
+    assert solver.ticks == ticks_before + 3  # but still counted
+
+    # A store write resumes real ticks and reaches the store.
+    resources[2].store.assign("c2_0", 60.0, 5.0,
+                              resources[2].store.get("c2_0").has, 999.0, 1)
+    solver.step(resources)
+    assert solver.idle_ticks == idle_before + 3  # this one was real
+    assert resources[2].store.get("c2_0").wants == 999.0
+    changed_has = resources[2].store.get("c2_0").has
+    assert changed_has > 0
+
+    # Idle re-engages after another two quiet rotations...
+    for _ in range(9):
+        solver.step(resources)
+        t[0] += 1.0
+    assert solver.idle_ticks > idle_before + 3
+
+    # ...and a capacity cut (epoch bump) breaks it same-tick.
+    for res in resources:
+        res.template.capacity = 100.0
+    solver.step(resources, config_epoch=1)
+    for res in resources:
+        assert res.store.sum_has <= 100.0 + 1e-9
+
+
+def test_dead_client_expires_on_schedule_while_server_stays_active():
+    """Reference semantics: a lease's expiry advances only when ITS
+    client refreshes (Decide stamps the requester; store.go:153-181).
+    Delivery must not renew leases, or a crashed client would hold its
+    capacity forever on any server that keeps ticking."""
+    t = [100.0]
+    clock = lambda: t[0]
+    engine, resources = make_prop_world(clock, n_res=4)  # lease 60s
+    solver = ResidentDenseSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=2
+    )
+    solver.step(resources)
+    res0 = resources[0]
+    assert res0.store.has_client("c0_0")
+
+    # 10 ticks x 10s: every client except c0_0 keeps refreshing, so the
+    # server never idles and deliveries keep landing on row 0.
+    for _ in range(10):
+        t[0] += 10.0
+        for r, res in enumerate(resources):
+            for c in range(5):
+                if (r, c) == (0, 0):
+                    continue  # the crashed client
+                name = f"c{r}_{c}"
+                lease = res.store.get(name)
+                res.store.assign(name, 60.0, 5.0, lease.has,
+                                 lease.wants, 1)
+        solver.step(resources)
+
+    # The dead client lapsed one lease length after its last refresh,
+    # and its capacity was reclaimed by the others.
+    assert not res0.store.has_client("c0_0"), (
+        "delivery renewed a dead client's lease"
+    )
+    assert len(res0.store) == 4
+    assert res0.store.sum_has == pytest.approx(1000.0)  # redistributed
