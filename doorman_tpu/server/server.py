@@ -393,10 +393,12 @@ class CapacityServer(CapacityServicer):
         return self._resident
 
     def _resident_eligible(self, resources: List[Resource]) -> bool:
-        """The resident path covers native batch servers whose resources
-        are all lane algorithms; PRIORITY_BANDS (its own dense part,
-        group caps) takes the BatchSolver. Recomputed only when the
-        config epoch or the resource set moves."""
+        """The resident path covers a native batch server's lane
+        (non-PRIORITY_BANDS) resources; a mixed config keeps the
+        resident fast path for the lane subset while the PRIORITY_BANDS
+        resources (their own dense part, group caps) tick through the
+        BatchSolver alongside it. Recomputed only when the config epoch
+        or the resource set moves."""
         if not self._native_store:
             return False
         key = (self._config_epoch, len(resources))
@@ -405,7 +407,7 @@ class CapacityServer(CapacityServicer):
 
             self._resident_ok_key = key
             engine = self._store_factory.__self__
-            self._resident_ok = engine.max_leases <= DENSE_MAX_K and all(
+            self._resident_ok = engine.max_leases <= DENSE_MAX_K and any(
                 algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
                 for r in resources
             )
@@ -427,11 +429,14 @@ class CapacityServer(CapacityServicer):
 
     @property
     def _ticks_done(self) -> int:
-        """Applied batch ticks across both tick paths (the serving
-        condition for store-backed grants)."""
+        """Applied batch ticks (the serving condition for store-backed
+        grants). max, not sum: a mixed config advances BOTH counters on
+        every tick_once (resident lane subset + BatchSolver priority
+        part), and summing would double-count — halving e.g. the
+        profiler capture window."""
         ticks = self._solver.ticks if self._solver is not None else 0
         if self._resident is not None:
-            ticks += self._resident.ticks
+            ticks = max(ticks, self._resident.ticks)
         return ticks
 
     async def tick_once(self) -> None:
@@ -467,9 +472,29 @@ class CapacityServer(CapacityServicer):
         if self._resident_eligible(resources):
             from doorman_tpu.solver.resident import ResidentOverflow
 
+            lane_res = [
+                r for r in resources
+                if algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
+            ]
+            prio_res = [
+                r for r in resources
+                if algo_kind_for(r.template) == AlgoKind.PRIORITY_BANDS
+            ]
+
             def resident_or_fallback():
                 try:
-                    self._resident_step(resources)
+                    self._resident_step(lane_res)
+                    if prio_res:
+                        # PRIORITY_BANDS resources tick through the
+                        # BatchSolver's priority part (group caps couple
+                        # only these rows, so the two solves are
+                        # independent); the lane subset keeps the
+                        # resident fast path.
+                        snap = solver.prepare(prio_res)
+                        gets = solver.solve(snap)
+                        solver.apply(
+                            prio_res, snap, gets, return_grants=False
+                        )
                 except ResidentOverflow:
                     # A resource outgrew the dense bucket mid-tick;
                     # pin this server to the BatchSolver path until the

@@ -520,14 +520,16 @@ class BatchSolver:
                 if res is None or not res.store.has_client(client_id):
                     continue
                 if resource_id in learn_ids:
-                    # Learning mode replays the client's reported has; use
-                    # the store's live value, not the snapshot-stale copy
-                    # the solve saw (a report landing mid-solve wins).
+                    # Learning mode replays the client's reported has —
+                    # the store's live value already IS the grant, so
+                    # there is nothing to write back.
                     grant = res.store.get(client_id).has
-                # Grants only: expiry/refresh advance when the client
-                # itself refreshes, never on delivery (reference
-                # semantics — a dead client must expire on schedule).
-                res.store.regrant(client_id, grant)
+                else:
+                    # Grants only: expiry/refresh advance when the
+                    # client itself refreshes, never on delivery
+                    # (reference semantics — a dead client must expire
+                    # on schedule).
+                    res.store.regrant(client_id, grant)
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
         self._apply_priority_part(by_id, snap, out, return_grants)
@@ -557,12 +559,11 @@ class BatchSolver:
             for j, client_id in enumerate(part.clients[i]):
                 if not res.store.has_client(client_id):
                     continue
-                grant = (
-                    res.store.get(client_id).has
-                    if part.learning[i]
-                    else float(part.gets[i, j])
-                )
-                res.store.regrant(client_id, grant)
+                if part.learning[i]:
+                    grant = res.store.get(client_id).has  # replay: no-op
+                else:
+                    grant = float(part.gets[i, j])
+                    res.store.regrant(client_id, grant)
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
 

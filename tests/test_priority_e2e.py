@@ -180,3 +180,40 @@ def test_priority_survives_native_roundtrip():
     assert dict(store.items())["c"].priority == 7
     *_, prio = engine.pack([store])
     assert list(prio) == [7]
+
+
+def test_mixed_config_keeps_resident_path_for_lane_resources():
+    """A config mixing PRIORITY_BANDS and lane resources must not lose
+    the resident fast path: the lane subset ticks device-resident while
+    the priority part goes through the BatchSolver — grants match the
+    pure-batch world exactly on both."""
+    async def scenario():
+        clock = FakeClock()
+        server = _make_server(clock, mode="batch", native=True)
+        await _setup(server, clock)
+        for client, res, wants, prio in [
+            ("a", "prio-a", 100.0, 5),
+            ("b", "prio-a", 50.0, 1),
+            ("d", "plain", 140.0, 0),
+            ("e", "plain", 80.0, 0),
+        ]:
+            await server.GetCapacity(_request(client, res, wants, prio), None)
+        for _ in range(3):
+            await server.tick_once()
+            clock.t += 1.0
+
+        # The resident path engaged for the lane subset...
+        assert server._resident is not None and server._resident.ticks >= 1
+        assert server._resident_ok
+        # ...serving the lane resource through it (proportional: 100
+        # capacity, wants 140+80 => scaled by 100/220, free-clamped)...
+        plain = dict(server.resources["plain"].store.items())
+        assert plain["d"].has + plain["e"].has == pytest.approx(100.0)
+        assert plain["d"].has > plain["e"].has > 0
+        # ...while the priority resource ticked through the batch part
+        # (band 5 first: a gets min(100, group cap 120) within cap 100).
+        prio_a = dict(server.resources["prio-a"].store.items())
+        assert prio_a["a"].has == pytest.approx(100.0)
+        assert prio_a["b"].has == pytest.approx(0.0, abs=1e-9)
+
+    asyncio.run(scenario())
