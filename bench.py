@@ -555,7 +555,44 @@ SERVER_WARMUP = 6
 TICKS_SERVER = 24
 
 
+def _require_backend(timeout_s: float = 180.0) -> None:
+    """Fail fast with a diagnostic JSON line when the device backend
+    does not come up (the tunneled TPU can go unreachable, in which
+    case jax.devices() blocks forever — a hung bench run tells the
+    caller nothing; a clear error line and a non-zero exit do)."""
+    import os
+    import threading
+
+    result = {}
+
+    def probe():
+        import jax
+
+        result["devices"] = [str(d) for d in jax.devices()]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" not in result:
+        print(
+            json.dumps(
+                {
+                    "metric": "backend_unreachable",
+                    "value": 0,
+                    "unit": "error",
+                    "note": (
+                        "jax backend did not initialize within "
+                        f"{timeout_s:.0f}s (device tunnel down?)"
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+
 if __name__ == "__main__":
+    _require_backend()
     gate_pallas_kernels()
     main()
     bench_server_tick()

@@ -80,6 +80,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, _I32P, _I64P, _F64P, _F64P, _F64P, _F64P, _I32P,
         _I64P, ctypes.c_int64,
     ]
+    lib.dm_regrant.restype = ctypes.c_int32
+    lib.dm_regrant.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.c_int64, ctypes.c_double]
     lib.dm_release.restype = ctypes.c_int32
     lib.dm_release.argtypes = [ctypes.c_void_p, ctypes.c_int32,
                                ctypes.c_int64]
@@ -498,14 +501,11 @@ class NativeLeaseStore:
 
     def regrant(self, client: str, has: float) -> None:
         """Update only the granted capacity of an existing lease (see
-        core.store.LeaseStore.regrant); expiry/refresh stay put."""
-        old = self.get(client)
-        if old is ZERO_LEASE:
-            return
-        self._lib.dm_assign(
-            self._ptr, self._rid, self._engine.client_handle(client),
-            old.expiry, old.refresh_interval, has, old.wants,
-            old.subclients, old.priority,
+        core.store.LeaseStore.regrant); expiry/refresh stay put and the
+        row is NOT dirtied — a delivery write-back is the solver's own
+        output, so it must not trigger a re-upload next tick."""
+        self._lib.dm_regrant(
+            self._ptr, self._rid, self._engine.client_handle(client), has
         )
 
     def release(self, client: str) -> None:

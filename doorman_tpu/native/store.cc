@@ -227,6 +227,23 @@ int64_t dm_bulk_assign(Engine *e, const int32_t *rid, const int64_t *cid,
   return assigned;
 }
 
+// Update ONLY the granted capacity of an existing lease — the
+// single-lease form of the apply write-back (same semantics: no expiry
+// or refresh change, and NO dirty marking: a grant delivery is the
+// solver writing its own output, not new demand; marking it dirty
+// would force a full re-upload next tick and defeat the idle fast
+// path). Returns 1 if the client held a lease, else 0.
+int32_t dm_regrant(Engine *e, int32_t rid, int64_t cid, double has) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  ResourceStore &r = e->resources[rid];
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) return 0;
+  Lease &l = r.leases[it->second];
+  r.sum_has += has - l.has;
+  l.has = has;
+  return 1;
+}
+
 // Returns 1 if the client held a lease (now removed), else 0.
 int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
   std::lock_guard<std::mutex> lock(e->mu);

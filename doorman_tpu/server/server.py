@@ -406,10 +406,18 @@ class CapacityServer(CapacityServicer):
             from doorman_tpu.solver.batch import DENSE_MAX_K
 
             self._resident_ok_key = key
-            engine = self._store_factory.__self__
-            self._resident_ok = engine.max_leases <= DENSE_MAX_K and any(
-                algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
+            # The width bound applies to the LANE resources only — a
+            # wide PRIORITY_BANDS resource (band aggregation is exactly
+            # the many-client use case) never enters the resident dense
+            # bucket and must not disable the fast path for the rest.
+            # ResidentOverflow backstops lane growth between rechecks.
+            lane_widths = [
+                len(r.store)
                 for r in resources
+                if algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
+            ]
+            self._resident_ok = bool(lane_widths) and (
+                max(lane_widths) <= DENSE_MAX_K
             )
         return self._resident_ok
 

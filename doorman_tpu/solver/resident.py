@@ -295,6 +295,12 @@ class ResidentDenseSolver:
                 break
             K = _bucket(kmax, 8)
         if kmax > DENSE_MAX_K:
+            # The rebuild already mutated row maps and drained dirty
+            # flags; invalidate the device tables so a LATER dispatch
+            # (e.g. the resident path resuming after the wide resource
+            # shrank or a config change) forces a clean rebuild instead
+            # of scattering into stale-shape tables.
+            self._wants = None
             raise ResidentOverflow(
                 f"resource with {kmax} clients exceeds the dense bucket "
                 f"cap {DENSE_MAX_K}"

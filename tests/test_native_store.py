@@ -363,3 +363,23 @@ def test_min_expiry_bound_sweeps_correctly():
     t[0] = 250.0
     assert engine.clean_all() == 1            # "a" finally lapses
     assert len(store) == 0
+
+
+def test_regrant_updates_has_without_dirtying_or_restamping():
+    """regrant is the single-lease delivery write-back: has and the
+    running sum move; expiry/refresh/wants stay put; the row is NOT
+    marked dirty (delivery is the solver's own output, and a dirty mark
+    would force a device re-upload and defeat the idle fast path)."""
+    engine = native.StoreEngine(clock=lambda: 100.0)
+    store = engine.store("r")
+    store.assign("a", 60.0, 5.0, 2.0, 10.0, 1)
+    engine.drain_dirty2()  # consume the insert's dirty mark
+
+    store.regrant("a", 7.5)
+    lease = store.get("a")
+    assert lease.has == 7.5 and store.sum_has == 7.5
+    assert lease.expiry == 160.0 and lease.wants == 10.0
+    rids, _ = engine.drain_dirty2()
+    assert len(rids) == 0, "regrant dirtied the row"
+    store.regrant("missing", 3.0)  # released mid-solve: no-op
+    assert store.sum_has == 7.5
