@@ -566,24 +566,29 @@ def _require_backend(timeout_s: float = 180.0) -> None:
     result = {}
 
     def probe():
-        import jax
+        try:
+            import jax
 
-        result["devices"] = [str(d) for d in jax.devices()]
+            result["devices"] = [str(d) for d in jax.devices()]
+        except Exception as e:  # report the real cause, not a timeout
+            result["error"] = f"{type(e).__name__}: {e}"
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join(timeout_s)
     if "devices" not in result:
+        note = result.get(
+            "error",
+            "jax backend did not initialize within "
+            f"{timeout_s:.0f}s (device tunnel down?)",
+        )
         print(
             json.dumps(
                 {
                     "metric": "backend_unreachable",
                     "value": 0,
                     "unit": "error",
-                    "note": (
-                        "jax backend did not initialize within "
-                        f"{timeout_s:.0f}s (device tunnel down?)"
-                    ),
+                    "note": note,
                 }
             ),
             flush=True,

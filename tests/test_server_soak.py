@@ -1,0 +1,168 @@
+"""System-level soak: the real stack end-to-end through config reload
+and master failover under live traffic.
+
+Two CapacityServers share one election KV (the real KVElection state
+machine); clients run the framework's own client library (master-aware
+connection, background refresh loop) against loopback gRPC. The
+timeline replays the reference's system-validation scenarios on the
+REAL server instead of the simulation (reference scenario 2/3:
+master loss and re-election; doc/design.md:773-799):
+
+  A. converge on the initial capacity through the resident tick path;
+  B. hot config reload cuts capacity — grants shrink within ticks;
+  C. the master's lock expires (fault injection); mastership moves,
+     the new master relearns from client reports, and client-side
+     capacity NEVER collapses (leases persist through the outage,
+     learning replays them — the reference's failover story).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.client.client import Client
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import InMemoryKV, KVElection
+from doorman_tpu.server.server import CapacityServer
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+
+def _config(cap):
+    return parse_yaml_config(
+        f"""
+resources:
+- identifier_glob: "shared"
+  capacity: {cap}
+  algorithm: {{kind: PROPORTIONAL_SHARE, lease_length: 60,
+               refresh_interval: 1, learning_mode_duration: 1}}
+- identifier_glob: "*"
+  capacity: 300
+  algorithm: {{kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+               learning_mode_duration: 1}}
+"""
+    )
+
+
+def _master_of(servers):
+    masters = [s for s in servers if s.is_master]
+    return masters[0] if len(masters) == 1 else None
+
+
+async def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        await asyncio.sleep(interval)
+    raise AssertionError("condition not reached in time")
+
+
+def test_soak_reload_and_failover_under_live_traffic():
+    async def body():
+        kv = InMemoryKV()
+        servers = []
+        for _ in range(2):
+            server = CapacityServer(
+                "pending", KVElection(kv, "/doorman/soak", ttl=0.6),
+                mode="batch", tick_interval=0.05,
+                minimum_refresh_interval=0.0, native_store=True,
+            )
+            port = await server.start(0, host="127.0.0.1")
+            # In production the server id IS its address
+            # (cmd/server.py); mastership redirects carry it.
+            server.id = f"127.0.0.1:{port}"
+            servers.append(server)
+        for server in servers:
+            await server.load_config(_config(1000))
+
+        master = await _wait(lambda: _master_of(servers))
+
+        # 10 clients on the oversubscribed "shared" resource through
+        # the real client library; half dial the standby to exercise
+        # the mastership redirect.
+        clients, resources = [], []
+        for i in range(10):
+            client = await Client.connect(
+                servers[i % 2].id, client_id=f"soak{i}",
+                minimum_refresh_interval=0.0,
+            )
+            clients.append(client)
+            resources.append(await client.resource("shared", 200.0))
+
+        def total():
+            return sum(r.current_capacity() for r in resources)
+
+        # Phase A: converge to the full 1000 (10 x 200 wants > 1000).
+        await _wait(lambda: abs(total() - 1000.0) < 1e-6)
+        store = master.resources["shared"].store
+        assert store.sum_has <= 1000.0 + 1e-6
+
+        # Phase B: hot reload cuts capacity to 400 on both servers (a
+        # shared config source would do the same); grants shrink to the
+        # new cap within ticks and client refreshes.
+        for server in servers:
+            await server.load_config(_config(400))
+        await _wait(lambda: abs(total() - 400.0) < 1e-6)
+        assert master.resources["shared"].store.sum_has <= 400.0 + 1e-6
+
+        # Phase C: the master's lock lapses. Mastership moves (either
+        # task may win the next campaign), the winner starts in
+        # learning mode and replays client-reported grants.
+        old_master = master
+        lows = []
+
+        async def sampler():
+            while True:
+                lows.append(total())
+                await asyncio.sleep(0.05)
+
+        sampling = asyncio.create_task(sampler())
+        won_at = old_master.became_master_at
+        kv.expire("/doorman/soak")
+        # The incumbent notices the lapsed lock at its next renewal,
+        # steps down (wiping all lease state), and a campaign decides a
+        # NEW mastership (either task can win; the incumbent often
+        # re-wins instantly, so detect the transition by a fresh
+        # became_master_at rather than a visible not-master window).
+        new_master = await _wait(
+            lambda: next(
+                (
+                    s for s in servers
+                    if s.is_master and s.became_master_at != won_at
+                ),
+                None,
+            ),
+            timeout=20,
+        )
+        # Clients keep refreshing against the new master (redirects) and
+        # converge back to the cut capacity.
+        await _wait(
+            lambda: new_master.resources.get("shared") is not None
+            and abs(total() - 400.0) < 1e-6,
+            timeout=20,
+        )
+        sampling.cancel()
+
+        # The failover never collapsed client-side capacity: leases
+        # persist through the outage and learning mode replays them
+        # (reference doc/design.md failover story). Allow transient
+        # redistribution but no crash toward zero.
+        assert min(lows) >= 200.0, f"capacity collapsed: min={min(lows)}"
+        assert new_master.resources["shared"].store.sum_has <= 400.0 + 1e-6
+        # Every client ends with a live grant.
+        assert all(r.current_capacity() > 0 for r in resources)
+
+        for client in clients:
+            await client.close()
+        for server in servers:
+            await server.stop()
+
+    asyncio.run(body())
