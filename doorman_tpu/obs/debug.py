@@ -139,8 +139,22 @@ class DebugServer:
                 f"<p>current master: "
                 f"{html.escape(st['current_master'] or '(unknown)')}<br>"
                 f"election: {html.escape(st['election'])}<br>"
-                f"mode: {html.escape(st['mode'])}</p>"
-                f"<table><tr><th>resource</th><th>capacity</th>"
+                f"mode: {html.escape(st['mode'])} | "
+                f"ticks: {st.get('ticks', 0)} "
+                f"(idle: {st.get('idle_ticks', 0)})</p>"
+                + (
+                    "<p>tick phases (total ms): "
+                    + html.escape(
+                        ", ".join(
+                            f"{k}={v:g}"
+                            for k, v in st["tick_phase_total_ms"].items()
+                        )
+                    )
+                    + "</p>"
+                    if st.get("tick_phase_total_ms")
+                    else ""
+                )
+                + f"<table><tr><th>resource</th><th>capacity</th>"
                 f"<th>algorithm</th><th>has</th>"
                 f"<th>wants</th><th>subclients</th><th>learning</th></tr>"
                 f"{rows}</table>"

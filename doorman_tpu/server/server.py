@@ -886,6 +886,22 @@ class CapacityServer(CapacityServicer):
             "election": str(self.election),
             "current_master": self.current_master,
             "mode": self.mode,
+            "ticks": self._ticks_done,
+            # Ticks the resident solver served without device work (the
+            # idle fast path); a busy server shows 0 here.
+            "idle_ticks": (
+                self._resident.idle_ticks
+                if self._resident is not None
+                else 0
+            ),
+            "tick_phase_total_ms": (  # cumulative since start
+                {
+                    k: round(v * 1000.0, 3)
+                    for k, v in self._resident.phase_s.items()
+                }
+                if self._resident is not None
+                else {}
+            ),
             "resources": {
                 rid: res.status() for rid, res in self.resources.items()
             },

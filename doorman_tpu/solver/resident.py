@@ -140,9 +140,17 @@ class ResidentDenseSolver:
         self.last_tick_seconds = 0.0
         self._quiet_ticks = 0
         # Per-phase wall-time accumulators (seconds) for the perf
-        # breakdown; bench.py reports them per tick. Keys: sweep, drain,
-        # pack, config, upload, launch, download, apply.
-        self.phase_s: Dict[str, float] = {}
+        # breakdown; bench.py reports them per tick. All keys exist from
+        # construction so readers (e.g. /debug/status on the event loop)
+        # can iterate while a tick in an executor thread updates values
+        # — the dict never resizes, only stores floats.
+        self.phase_s: Dict[str, float] = {
+            name: 0.0
+            for name in (
+                "sweep", "drain", "config", "pack", "upload", "launch",
+                "download", "apply",
+            )
+        }
 
         self._rows: List[Resource] = []
         self._row_lut = np.full(1, -1, np.int64)
