@@ -218,10 +218,19 @@ class ResidentDenseSolver:
         if self._rotate_override is None and self._tick_interval and rows:
             # Delivery must cover the whole table at least once per
             # refresh interval, else a client can refresh against a
-            # store row older than its own cadence.
+            # store row older than its own cadence. Capped at 64:
+            # beyond that the per-tick rotation slice is already tiny
+            # (R/64 rows), while an uncapped derivation from a
+            # slow-refresh config (say 3600s refresh at 50ms ticks)
+            # would stretch a full delivery cycle — and the idle fast
+            # path's two-rotation threshold — into the tens of
+            # thousands of ticks.
             self._rotate = max(
                 1,
-                int(refresh[: len(rows)].min() / self._tick_interval),
+                min(
+                    int(refresh[: len(rows)].min() / self._tick_interval),
+                    64,
+                ),
             )
         if self._kind_h is None or not np.array_equal(kind, self._kind_h):
             self._kind_h, self._kind_d = kind, self._put(kind)

@@ -339,7 +339,8 @@ resources:
                 await asyncio.sleep(0.02)
             res = server.resources["shared"]
             assert res.store.sum_has == pytest.approx(1000.0, rel=1e-6)
-            assert server._resident.rotate_ticks >= 100
+            # Derived from 30s refresh / 0.05s ticks, capped at 64.
+            assert server._resident.rotate_ticks == 64
 
             ticks_at_cut = server._resident.ticks
             await server.load_config(config(100))
