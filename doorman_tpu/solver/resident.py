@@ -25,7 +25,8 @@ moves only what changed:
             information model is exactly this stale — client-reported
             `has` lags by a refresh interval,
             go/server/doorman/server.go:732-817). `rotate_ticks` derives
-            from min(refresh_interval)/tick_interval unless pinned.
+            from min(refresh_interval)/tick_interval (capped at 64)
+            unless pinned.
 
 Idle servers cost no device work: once two full rotations have
 delivered with no store or config changes, the store provably equals
@@ -121,10 +122,10 @@ class ResidentDenseSolver:
         self._clock = clock
         # rotate_ticks=None derives the rotation from the config each
         # time templates are read: delivery rides the fastest refresh
-        # cadence (min refresh_interval / tick_interval), which is the
-        # staleness the reference's own information model already has —
-        # client-reported state lags by one refresh interval. An explicit
-        # int pins it (bench tuning).
+        # cadence (min refresh_interval / tick_interval, capped at 64),
+        # which is the staleness the reference's own information model
+        # already has — client-reported state lags by one refresh
+        # interval. An explicit int pins it (bench tuning).
         self._tick_interval = tick_interval
         self._rotate_override: "int | None" = None
         if rotate_ticks is None:

@@ -328,8 +328,9 @@ resources:
             for i in range(20):  # 20 x 200 wants >> capacity
                 await stub.GetCapacity(request(i))
             # Converge on the 1000-capacity allocation. The 30s
-            # refresh_interval vs 0.05s ticks gives rotate_ticks=600:
-            # rotation cannot be what delivers the cut below.
+            # refresh_interval vs 0.05s ticks derives rotate_ticks=600,
+            # capped at 64 — either way far beyond the couple of ticks
+            # the cut below must land within.
             for _ in range(400):
                 if (
                     server._resident is not None
