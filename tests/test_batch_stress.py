@@ -137,7 +137,11 @@ def test_batch_native_stress_grants_and_loop_responsiveness():
         # on one asyncio loop, Discovery stays well under the tick
         # interval's worth of stall.
         lat = np.array(latencies)
-        assert len(lat) > 20
+        # Each probe cycle is ~(0.02s sleep + Discovery latency); under
+        # load ~20 cycles fit the 3s window, so demanding >20 sat right
+        # on the boundary and flaked. 10+ samples is plenty for the
+        # median/max bounds that carry the actual claim.
+        assert len(lat) >= 10
         assert float(np.median(lat)) < 0.15, float(np.median(lat))
         assert float(lat.max()) < 2.0, float(lat.max())
 
