@@ -65,7 +65,8 @@ async def _wait(predicate, timeout=15.0, interval=0.05):
     raise AssertionError("condition not reached in time")
 
 
-def test_soak_reload_and_failover_under_live_traffic():
+@pytest.mark.parametrize("native", [True, False])
+def test_soak_reload_and_failover_under_live_traffic(native):
     async def body():
         kv = InMemoryKV()
         servers = []
@@ -73,7 +74,7 @@ def test_soak_reload_and_failover_under_live_traffic():
             server = CapacityServer(
                 "pending", KVElection(kv, "/doorman/soak", ttl=0.6),
                 mode="batch", tick_interval=0.05,
-                minimum_refresh_interval=0.0, native_store=True,
+                minimum_refresh_interval=0.0, native_store=native,
             )
             port = await server.start(0, host="127.0.0.1")
             # In production the server id IS its address
