@@ -106,3 +106,46 @@ def test_pack_process_edges_solves_to_single_device_result():
     expected = np.asarray(jax.jit(solve_tick)(edges, resources))
     np.testing.assert_allclose(gets[:90], expected, rtol=1e-12, atol=1e-12)
     assert (gets[90:] == 0).all()  # padded edges granted nothing
+
+
+def test_initialize_wires_env_fallbacks(monkeypatch):
+    """initialize() plumbs DOORMAN_* env into jax.distributed.initialize
+    (the real call needs a live coordinator, so record the arguments);
+    without a coordinator configured it must be a no-op."""
+    from doorman_tpu.parallel import multihost
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    monkeypatch.setattr(multihost, "_initialized", False)
+
+    # No coordinator anywhere: single-host no-op.
+    monkeypatch.delenv("DOORMAN_COORDINATOR", raising=False)
+    multihost.initialize()
+    assert calls == []
+
+    monkeypatch.setenv("DOORMAN_COORDINATOR", "10.0.0.1:1234")
+    monkeypatch.setenv("DOORMAN_NUM_PROCESSES", "4")
+    monkeypatch.setenv("DOORMAN_PROCESS_ID", "2")
+    multihost.initialize()
+    assert calls == [
+        {
+            "coordinator_address": "10.0.0.1:1234",
+            "num_processes": 4,
+            "process_id": 2,
+            "local_device_ids": None,
+        }
+    ]
+    # Idempotent: a second call does not re-initialize.
+    multihost.initialize()
+    assert len(calls) == 1
+
+    # Explicit arguments win over env.
+    monkeypatch.setattr(multihost, "_initialized", False)
+    multihost.initialize(
+        coordinator_address="h:9", num_processes=2, process_id=1
+    )
+    assert calls[-1]["coordinator_address"] == "h:9"
+    assert calls[-1]["num_processes"] == 2
