@@ -561,26 +561,16 @@ def _require_backend(timeout_s: float = 180.0) -> None:
     case jax.devices() blocks forever — a hung bench run tells the
     caller nothing; a clear error line and a non-zero exit do)."""
     import os
-    import threading
 
-    result = {}
+    from doorman_tpu.utils.backend import probe_backend
 
-    def probe():
-        try:
-            import jax
-
-            result["devices"] = [str(d) for d in jax.devices()]
-        except Exception as e:  # report the real cause, not a timeout
-            result["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" not in result:
-        note = result.get(
-            "error",
-            "jax backend did not initialize within "
-            f"{timeout_s:.0f}s (device tunnel down?)",
+    devices, exc = probe_backend(timeout_s)
+    if devices is None:
+        note = (
+            f"{type(exc).__name__}: {exc}"
+            if exc is not None
+            else "jax backend did not initialize within "
+            f"{timeout_s:.0f}s (device tunnel down?)"
         )
         print(
             json.dumps(

@@ -1,0 +1,37 @@
+"""Backend initialization watchdog.
+
+jax.devices() blocks forever when the tunneled device backend is
+unreachable; callers that must not hang (the bench, the driver's entry
+compile-check) probe it on a daemon thread with a deadline instead.
+One shared implementation so the bench and the entry point cannot
+drift.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+
+def probe_backend(
+    timeout_s: float = 180.0,
+) -> Tuple[Optional[list], Optional[BaseException]]:
+    """Initialize jax's default backend with a deadline.
+
+    Returns (devices, None) on success, (None, exception) when
+    initialization failed fast, and (None, None) when it timed out —
+    the abandoned daemon thread keeps blocking harmlessly."""
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+        except Exception as e:
+            result["exc"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return result.get("devices"), result.get("exc")
