@@ -52,3 +52,15 @@ def test_command_line_beats_env(monkeypatch):
     populate(parser, "DOORMAN")
     args = parser.parse_args(["--port", "7"])
     assert args.port == 7
+
+
+def test_probe_backend_returns_devices_when_backend_is_up():
+    """The watchdog's happy path: under the test conftest (CPU pinned)
+    the backend comes up immediately and the probe reports devices with
+    no error; the timeout/error paths are exercised by bench.py and
+    __graft_entry__ against a genuinely unreachable backend."""
+    from doorman_tpu.utils.backend import probe_backend
+
+    devices, exc = probe_backend(timeout_s=60.0)
+    assert exc is None
+    assert devices  # the 8 virtual CPU devices
