@@ -310,7 +310,8 @@ def bench_server_tick() -> None:
 
     # Spot-check the first tick against the numpy oracles: after it,
     # has == grants computed from (capacity, wants, has=0).
-    from doorman_tpu.algorithms import tick as oracle
+    from doorman_tpu.algorithms.tick import oracle_row
+    from doorman_tpu.core.resource import static_param
 
     for r in rng.integers(0, R, 10):
         res = resources[r]
@@ -318,15 +319,10 @@ def bench_server_tick() -> None:
         w = np.array([lease.wants for lease in st])
         g = np.array([lease.has for lease in st])
         k = int(kinds[r])
-        c = float(capacity[r])
-        if k == pb.Algorithm.NO_ALGORITHM:
-            expected = oracle.none_tick(w)
-        elif k == pb.Algorithm.STATIC:
-            expected = oracle.static_tick(c, w)
-        elif k == pb.Algorithm.PROPORTIONAL_SHARE:
-            expected = oracle.proportional_snapshot(c, w, np.zeros_like(w))
-        else:
-            expected = oracle.fair_share_waterfill(c, w, np.ones_like(w))
+        expected = oracle_row(
+            k, float(capacity[r]), static_param(res.template),
+            w, np.zeros_like(w), np.ones_like(w),
+        )
         np.testing.assert_allclose(
             g, expected, rtol=2e-6, atol=1e-4, err_msg=f"res{r} kind {k}"
         )

@@ -136,13 +136,17 @@ class EtcdKV(LeaseKV):
     after a standby wins (the v2 client's 5s timeout had the same
     role)."""
 
-    # Mastership-loss detection must fit inside KVElection's renewal
-    # cadence (ttl/3 with ttl defaulting to 10s), not the gateway's
-    # lenient config-watch default. This bounds BOTH each HTTP request
-    # and (via asyncio.wait_for in _call) the whole operation — with
-    # several endpoints the per-endpoint retries would otherwise stack
-    # past the lock TTL and re-open the split-brain window the timeout
-    # exists to close.
+    # Cap for any single gateway HTTP request (the gateway splits it
+    # across endpoints on failover). Mastership-loss detection must fit
+    # inside KVElection's renewal cadence (ttl/3 with ttl defaulting to
+    # 10s), not the gateway's lenient config-watch default — so each
+    # OPERATION also gets an overall budget, sized to the number of
+    # sequential RPCs it issues (refresh: min(this, ttl/2); acquire,
+    # which is not on the loss-detection path, gets 3x for its
+    # get + lease-grant + transactional-put sequence). Budgeting each
+    # request off the operation's shared deadline keeps the sum inside
+    # the budget instead of stacking per-request timeouts past the lock
+    # TTL and re-opening the split-brain window.
     REQUEST_TIMEOUT = 5.0
 
     def __init__(self, endpoints: list[str]):
@@ -150,11 +154,27 @@ class EtcdKV(LeaseKV):
         self._leases: Dict[str, int] = {}  # lock key -> held lease id
         self._fast_watches = 0  # consecutive instant watch returns
 
-    async def _call(self, fn):
+    def _per_request(self, budget: float) -> Callable[[], float]:
+        """Per-HTTP-request timeouts drawn from one operation deadline:
+        each call gets the remaining budget (capped at REQUEST_TIMEOUT,
+        floored so a nearly-exhausted deadline still issues a fast
+        request rather than one that cannot succeed at all — the floor
+        is sized per endpoint because the gateway splits it across its
+        failover list)."""
+        end = time.monotonic() + budget
+        floor = 0.1 * len(self._gw.endpoints)
+        return lambda: max(
+            min(self.REQUEST_TIMEOUT, end - time.monotonic()), floor
+        )
+
+    async def _call(self, fn, budget: float):
         try:
+            # Slack over the inner budget: requests that hit the
+            # deadline floor should resolve (or fail) on their own and
+            # surface their real outcome, not be abandoned mid-flight.
             return await asyncio.wait_for(
                 asyncio.get_running_loop().run_in_executor(None, fn),
-                self.REQUEST_TIMEOUT,
+                budget + min(1.0, budget / 4),
             )
         except Exception as e:
             # Failures are expected during partitions, but silence here
@@ -162,6 +182,18 @@ class EtcdKV(LeaseKV):
             # the campaign loop would just never win, quietly.
             log.warning("etcd election request failed: %r", e)
             return None
+
+    def _revoke_quietly(self, lease_id: int) -> bool:
+        """Best-effort lease revoke at the full REQUEST_TIMEOUT — OFF
+        any operation budget, because cleanup matters most precisely
+        when the budget is already spent. Returns True when etcd
+        confirmed the revoke (callers use this to decide whether their
+        backstop must stay armed); on False the TTL is the backstop."""
+        try:
+            self._gw.lease_revoke(lease_id, timeout=self.REQUEST_TIMEOUT)
+            return True
+        except Exception:
+            return False
 
     def _spawn_revoke(self, lease_id: "int | None") -> None:
         """Best-effort background revoke of a lease whose operation we
@@ -172,22 +204,16 @@ class EtcdKV(LeaseKV):
         renewing it)."""
         if not lease_id:
             return
-
-        def revoke():
-            try:
-                self._gw.lease_revoke(
-                    lease_id, timeout=self.REQUEST_TIMEOUT
-                )
-            except Exception:
-                pass  # unreachable etcd: the TTL is the backstop
-
         try:
-            asyncio.get_running_loop().run_in_executor(None, revoke)
+            asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._revoke_quietly(lease_id)
+            )
         except RuntimeError:
             pass  # loop shutting down
 
     async def acquire(self, key, value, ttl) -> bool:
-        t = self.REQUEST_TIMEOUT
+        budget = 3.0 * self.REQUEST_TIMEOUT
+        t = self._per_request(budget)
         # Shared with the executor thread: `lease` is the granted lease
         # (if any), `abandoned` is set when the caller stops waiting.
         # Every interleaving must end with an unrenewed lock revoked:
@@ -205,34 +231,39 @@ class EtcdKV(LeaseKV):
             # Cheap existence probe first: the standby's campaign loop
             # runs for the deployment's lifetime and the lock is almost
             # always held — don't churn lease grants on every cycle.
-            if self._gw.get(key, timeout=t) is not None:
+            if self._gw.get(key, timeout=t()) is not None:
                 return None
-            lease_id = self._gw.lease_grant(ttl, timeout=t)
+            lease_id = self._gw.lease_grant(ttl, timeout=t())
             state["lease"] = lease_id
             try:
                 won = self._gw.put_if_absent(
-                    key, value, lease_id, timeout=t
+                    key, value, lease_id, timeout=t()
                 )
             except Exception:
                 # The put may have COMMITTED in etcd even though the
                 # response was lost: revoke so a lock nobody will renew
-                # cannot survive, then surface the failure.
-                try:
-                    self._gw.lease_revoke(lease_id, timeout=t)
-                except Exception:
-                    pass
-                state["lease"] = None
+                # cannot survive, then surface the failure. `lease`
+                # stays recorded when the revoke fails so the caller's
+                # _spawn_revoke backstop still fires.
+                if self._revoke_quietly(lease_id):
+                    state["lease"] = None
                 raise
             if state["abandoned"] or not won:
-                try:
-                    self._gw.lease_revoke(lease_id, timeout=t)
-                except Exception:
-                    pass  # it expires on its own
-                state["lease"] = None
+                if self._revoke_quietly(lease_id):
+                    state["lease"] = None
                 return None
             return lease_id
 
-        lease_id = await self._call(attempt)
+        try:
+            lease_id = await self._call(attempt, budget)
+        except asyncio.CancelledError:
+            # stop() during an in-flight campaign: the executor thread
+            # may still win the lock after we are gone. Mark it
+            # abandoned (the thread self-revokes on its check) and
+            # backstop any already-granted lease ourselves.
+            state["abandoned"] = True
+            self._spawn_revoke(state["lease"])
+            raise
         if lease_id is None:
             # We are about to report "not master": no lock created by
             # the (possibly still-running) thread may survive unrenewed.
@@ -246,17 +277,20 @@ class EtcdKV(LeaseKV):
         lease_id = self._leases.get(key)
         if lease_id is None:
             return False
-        t = self.REQUEST_TIMEOUT
+        # The loss-detection path: sleep(ttl/3) + this operation must
+        # conclude well before the lock TTL lapses and a standby wins.
+        budget = min(self.REQUEST_TIMEOUT, ttl / 2.0)
+        t = self._per_request(budget)
 
         def renew() -> bool:
-            if self._gw.lease_keepalive(lease_id, timeout=t) <= 0:
+            if self._gw.lease_keepalive(lease_id, timeout=t()) <= 0:
                 return False
             # The LeaseKV contract: extend iff the key still holds OUR
             # value. A lease can outlive the key (operator `etcdctl del`
             # to force a new election, or an overwrite): renewing on the
             # lease alone would leave two masters.
             try:
-                held = self._gw.get(key, timeout=t)
+                held = self._gw.get(key, timeout=t())
                 ours = held is not None and held.decode() == value
             except Exception:
                 ours = False  # can't verify ownership: step down
@@ -266,27 +300,33 @@ class EtcdKV(LeaseKV):
                 # for that long with nobody renewing — a full-TTL
                 # leaderless window. Release it so re-election is
                 # immediate.
-                try:
-                    self._gw.lease_revoke(lease_id, timeout=t)
-                except Exception:
-                    pass  # unreachable etcd: the TTL is the backstop
+                self._revoke_quietly(lease_id)
             return ours
 
-        ok = await self._call(renew)
+        try:
+            ok = await self._call(renew, budget)
+        except asyncio.CancelledError:
+            # stop() mid-renewal: the thread's keepalive may have just
+            # extended the lease to a full TTL; do not leave it pinned
+            # by a master that no longer exists.
+            self._spawn_revoke(lease_id)
+            self._leases.pop(key, None)
+            raise
         if not ok:
             # Mastership is lost; a fresh acquire grants a fresh lease.
-            # On a timeout the thread may still be mid-renewal (and may
-            # have just extended the TTL): revoke so the lock is not
-            # pinned by a master that has already stepped down.
-            if ok is None:
-                self._spawn_revoke(lease_id)
+            # The thread may still be mid-renewal (timeout), or its own
+            # step-down revoke may have failed: backstop-revoke on every
+            # failure (revoking a dead lease is harmless) so the lock is
+            # not pinned by a master that has already stepped down.
+            self._spawn_revoke(lease_id)
             self._leases.pop(key, None)
             return False
         return True
 
     async def get(self, key) -> Optional[str]:
         value = await self._call(
-            lambda: self._gw.get(key, timeout=self.REQUEST_TIMEOUT)
+            lambda: self._gw.get(key, timeout=self.REQUEST_TIMEOUT),
+            self.REQUEST_TIMEOUT,
         )
         return value.decode() if value is not None else None
 

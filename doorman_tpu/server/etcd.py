@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import base64
 import json
+import time
 import urllib.request
 from typing import List, Optional
 
@@ -40,17 +41,37 @@ class EtcdGateway:
             (e if "://" in e else f"http://{e}").rstrip("/")
             for e in endpoints
         ]
+        # Where wait_for_change starts its endpoint walk; advanced past
+        # endpoints that fail to establish a watch (benign int race when
+        # shared across executor threads).
+        self._watch_endpoint = 0
+
+    def _failover_budgets(self, timeout: float):
+        """Yield (endpoint, per_endpoint_timeout) pairs such that the
+        WHOLE failover sequence fits in `timeout`: the remaining budget
+        is split evenly across the endpoints not yet tried, so a
+        partitioned endpoint (which eats its slice to the last
+        millisecond) still leaves the healthy ones a real share, while
+        one that fails fast (connection refused) barely dents the
+        budget and later endpoints inherit nearly all of it."""
+        deadline = time.monotonic() + timeout
+        for i, endpoint in enumerate(self.endpoints):
+            per = (deadline - time.monotonic()) / (len(self.endpoints) - i)
+            if per <= 0:
+                return
+            yield endpoint, per
 
     def _post(self, path: str, payload: dict, timeout: float = 30.0) -> dict:
+        data = json.dumps(payload).encode()
         last_err: Exception = RuntimeError("no endpoints")
-        for endpoint in self.endpoints:
+        for endpoint, per in self._failover_budgets(timeout):
             try:
                 req = urllib.request.Request(
                     endpoint + path,
-                    data=json.dumps(payload).encode(),
+                    data=data,
                     headers={"Content-Type": "application/json"},
                 )
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                with urllib.request.urlopen(req, timeout=per) as resp:
                     return json.loads(resp.read().decode())
             except Exception as e:  # try the next endpoint
                 last_err = e
@@ -144,7 +165,22 @@ class EtcdGateway:
         Returns False when every endpoint failed before establishing a
         watch — the caller should escalate its backoff."""
         payload = {"create_request": {"key": _b64(key)}}
-        for endpoint in self.endpoints:
+        # Unlike _post, each endpoint gets the FULL remaining budget:
+        # splitting it would shrink the idle window of a perfectly
+        # healthy watch to timeout/n, multiplying the caller's re-watch
+        # + get-poll churn by the endpoint count. Failover instead works
+        # across calls: an endpoint that fails before establishing a
+        # watch is skipped on the next call (the caller loops), so one
+        # burned cycle moves the watch to a healthy endpoint for good.
+        deadline = time.monotonic() + timeout
+        n = len(self.endpoints)
+        start = self._watch_endpoint  # snapshot: the loop mutates it
+        for j in range(n):
+            per = deadline - time.monotonic()
+            if per <= 0:
+                break
+            i = (start + j) % n
+            endpoint = self.endpoints[i]
             established = False
             try:
                 req = urllib.request.Request(
@@ -152,24 +188,31 @@ class EtcdGateway:
                     data=json.dumps(payload).encode(),
                     headers={"Content-Type": "application/json"},
                 )
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                with urllib.request.urlopen(req, timeout=per) as resp:
                     while True:
                         line = resp.readline()
                         if not line:
+                            self._watch_endpoint = i
                             return True  # stream closed cleanly
                         try:
                             frame = json.loads(line.decode())
                         except ValueError:
+                            self._watch_endpoint = i
                             return True
                         established = True  # got a frame (creation ack)
                         result = frame.get("result", frame)
                         if result.get("events"):
+                            self._watch_endpoint = i
                             return True  # the key changed
                         # else: keep waiting for an event frame
             except Exception:
                 if established:
                     # Idle timeout on a live watch: healthy, just no
                     # change within `timeout`.
+                    self._watch_endpoint = i
                     return True
-                continue  # endpoint failed before the watch existed
+                # Endpoint failed before the watch existed: start the
+                # next call (and the next iteration) past it.
+                self._watch_endpoint = (i + 1) % n
+                continue
         return False

@@ -35,6 +35,7 @@ class FakeEtcd:
     is host:port for client endpoint lists."""
 
     def __init__(self):
+        self.latency = 0.0  # per-request delay (slow-etcd fault injection)
         self._lock = threading.Lock()
         # key -> (value, lease_id, create_revision)
         self._kv: Dict[str, Tuple[str, int, int]] = {}
@@ -237,6 +238,8 @@ class FakeEtcd:
             protocol_version = "HTTP/1.0"
 
             def do_POST(self):
+                if fake.latency:
+                    time.sleep(fake.latency)
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 try:

@@ -226,3 +226,109 @@ def test_degenerate_watch_does_not_busy_loop():
     # the pacing floor (0.05s, escalating to the poll interval after 5
     # consecutive instant returns) keeps the rate bounded.
     assert elapsed >= 0.5, f"watch cycles not paced: {elapsed:.3f}s"
+
+
+def test_acquire_fails_over_past_partitioned_endpoint(fake, monkeypatch):
+    """A partitioned endpoint (accepts TCP, never answers) listed FIRST
+    must not eat the whole operation budget: the gateway splits the
+    budget across endpoints, so the healthy fake still gets a real
+    share and the acquire wins. Before the deadline-budgeted failover,
+    the first endpoint burned the full per-request timeout while the
+    operation deadline (the same value) expired — acquire could never
+    succeed with any unreachable endpoint ahead of a healthy one."""
+    import socket
+    import time
+
+    blackhole = socket.socket()
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(1)  # handshake completes; nothing ever answers
+    addr = f"127.0.0.1:{blackhole.getsockname()[1]}"
+    monkeypatch.setattr(EtcdKV, "REQUEST_TIMEOUT", 1.0)
+
+    async def body():
+        kv = EtcdKV([addr, fake.address])
+        t0 = time.monotonic()
+        won = await kv.acquire("/lock", "me", ttl=10.0)
+        elapsed = time.monotonic() - t0
+        assert won, "healthy second endpoint never got a fair budget"
+        # Budget is 3x REQUEST_TIMEOUT + slack; the win must land
+        # inside it, not after stacked per-endpoint timeouts.
+        assert elapsed < 4.5, f"acquire took {elapsed:.2f}s"
+        assert await kv.refresh("/lock", "me", ttl=10.0)
+
+    try:
+        asyncio.run(body())
+    finally:
+        blackhole.close()
+    assert fake.value("/lock") == "me"
+
+
+def test_acquire_sequential_rpcs_fit_the_operation_budget(fake, monkeypatch):
+    """acquire issues get + lease_grant + put_if_absent sequentially; a
+    slow-but-healthy etcd whose per-request latency exceeds a third of
+    REQUEST_TIMEOUT must still win within the operation budget (3x).
+    Under the old single-REQUEST_TIMEOUT deadline this combination
+    could never acquire mastership at all."""
+    monkeypatch.setattr(EtcdKV, "REQUEST_TIMEOUT", 0.5)
+    fake.latency = 0.25  # 3 RPCs x 0.25s = 0.75s > 0.5s
+
+    async def body():
+        kv = EtcdKV([fake.address])
+        assert await kv.acquire("/lock", "slowpoke", ttl=10.0)
+
+    asyncio.run(body())
+    fake.latency = 0.0
+    assert fake.value("/lock") == "slowpoke"
+
+
+def test_stop_during_inflight_acquire_leaves_no_pinned_lock(fake, monkeypatch):
+    """Cancelling a campaign mid-acquire (KVElection.stop during
+    shutdown) must not leave the lock key pinned under the departed
+    server's id: the executor thread may win the lock AFTER the task
+    died, and only the abandoned/backstop-revoke machinery reclaims it
+    before the full TTL."""
+    import time
+
+    monkeypatch.setattr(EtcdKV, "REQUEST_TIMEOUT", 1.0)
+    fake.latency = 0.4  # keep the acquire in flight when we cancel
+
+    async def body():
+        kv = EtcdKV([fake.address])
+        task = asyncio.ensure_future(kv.acquire("/lock", "ghost", ttl=10.0))
+        await asyncio.sleep(0.6)  # thread is between grant and put
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(body())
+    fake.latency = 0.0
+    # The thread's abandoned check (or the caller's backstop revoke)
+    # must reclaim the lock well before the 10s TTL would.
+    deadline = time.time() + 5.0
+    while time.time() < deadline and fake.value("/lock") is not None:
+        time.sleep(0.1)
+    assert fake.value("/lock") is None, "lock pinned by a cancelled acquire"
+
+
+def test_watch_walk_reaches_healthy_endpoint_between_dead_ones(fake):
+    """The watch's endpoint walk must try each endpoint once per call:
+    with [dead, healthy, dead], the two connection-refused fast-fails
+    advance the walk and the healthy endpoint establishes the watch
+    (regression: the walk index once read the mutating rotation state,
+    repeating dead endpoints and never reaching the healthy one)."""
+    import socket
+
+    def dead_addr():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens: connection refused, instantly
+        return f"127.0.0.1:{port}"
+
+    gw = EtcdGateway([dead_addr(), fake.address, dead_addr()])
+    gw.put("/k", "v0")
+    assert gw.wait_for_change("/k", timeout=2.0) is True
+    # Subsequent calls start straight at the endpoint that worked.
+    assert gw.endpoints[gw._watch_endpoint].endswith(fake.address)
