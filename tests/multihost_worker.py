@@ -1,0 +1,93 @@
+"""Worker half of the REAL two-process multi-host test.
+
+Launched twice by tests/test_multihost.py::test_two_process_distributed_solve
+with DOORMAN_COORDINATOR / DOORMAN_NUM_PROCESSES / DOORMAN_PROCESS_ID in
+the environment — the exact wiring a production multi-host deployment
+uses (parallel/multihost.py `initialize`). Each process owns 2 virtual
+CPU devices and ONLY its own half of the edge table; the global sharded
+solve must still equal the single-device full-table solve, proving the
+host-local packing + process-ordered mesh + cross-process psum really
+compose (not just the single-process simulation of them the unit tests
+cover).
+
+Prints MULTIHOST WORKER OK on success; any mismatch raises.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Cross-process CPU collectives need an explicit implementation; gloo
+# ships with jax's CPU PJRT plugin.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+
+def main() -> None:
+    from doorman_tpu.parallel import make_sharded_solver, multihost
+    from doorman_tpu.parallel.sharded import replicate_resources
+    from doorman_tpu.solver.kernels import solve_tick
+
+    from __graft_entry__ import _example_batch
+
+    multihost.initialize()  # DOORMAN_* env wiring under test
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.local_devices()) == 2
+
+    # Both processes build the same deterministic global table, but each
+    # feeds ONLY its own host block through the packing path.
+    edges, resources = _example_batch(n_resources=8, edges_per_resource=16)
+    n_edges = int(np.asarray(edges.active).shape[0])
+
+    mesh = multihost.make_multihost_mesh(("dc", "clients"))
+    blocks = multihost.split_edges_by_host(edges, jax.process_count())
+    local = blocks[jax.process_index()]
+    edges_per_host = n_edges // 2 + 6  # uneven block: exercises padding
+    packed = multihost.pack_process_edges(
+        mesh, local, edges_per_host=edges_per_host
+    )
+    gets = make_sharded_solver(mesh)(
+        packed, replicate_resources(mesh, resources)
+    )
+    jax.block_until_ready(gets)
+
+    # Expected global layout: host i's block (its slice of the
+    # single-device full-table solve) padded to the agreed per-host
+    # size with zeros (inactive edges solve to 0).
+    expected_full = np.asarray(jax.jit(solve_tick)(edges, resources))
+    per_host = n_edges // 2
+    eph = edges_per_host + (-edges_per_host) % 2  # per-host device mult
+    expected_global = np.zeros(eph * 2, expected_full.dtype)
+    for h in range(2):
+        expected_global[h * eph : h * eph + per_host] = expected_full[
+            h * per_host : (h + 1) * per_host
+        ]
+
+    # Each process can only address its own shards: compare shard-wise.
+    checked = 0
+    for shard in gets.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data),
+            expected_global[shard.index],
+            rtol=1e-12,
+            atol=1e-12,
+        )
+        checked += 1
+    assert checked > 0, "process addressed no shards"
+    print(f"MULTIHOST WORKER OK process={jax.process_index()} "
+          f"shards={checked}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

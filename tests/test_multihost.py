@@ -1,12 +1,13 @@
 """Multi-host packing math and the host-shard solve path on the CPU mesh.
 
-True multi-process slices can't run under pytest, but everything pure is
-pinned here: the per-host block padding, the deal/reassemble identity,
-the process-ordered mesh layout, and the single-process
-`pack_process_edges` path solved end-to-end against the single-device
-oracle (the same path `__graft_entry__.dryrun_multichip` exercises).
-Reference being matched: the server tree spans hosts by construction
-(doc/design.md:204-220)."""
+Everything pure is pinned here: the per-host block padding, the
+deal/reassemble identity, the process-ordered mesh layout, and the
+single-process `pack_process_edges` path solved end-to-end against the
+single-device oracle (the same path `__graft_entry__.dryrun_multichip`
+exercises). The final test then runs the REAL thing: two OS processes
+joined by `jax.distributed` with gloo CPU collectives, each packing only
+its own host block (tests/multihost_worker.py). Reference being matched:
+the server tree spans hosts by construction (doc/design.md:204-220)."""
 
 import numpy as np
 import jax
@@ -149,3 +150,68 @@ def test_initialize_wires_env_fallbacks(monkeypatch):
     )
     assert calls[-1]["coordinator_address"] == "h:9"
     assert calls[-1]["num_processes"] == 2
+
+
+def test_two_process_distributed_solve_over_gloo():
+    """The REAL multi-process path: two OS processes, each owning 2
+    virtual CPU devices and only ITS half of the edge table, joined by
+    `multihost.initialize` (DOORMAN_* env wiring) with gloo collectives.
+    Each worker packs host-locally, runs the sharded solve over the
+    process-ordered mesh, and compares its addressable shards against
+    the single-device full-table oracle (tests/multihost_worker.py).
+    This is the composition the single-process unit tests above can
+    only simulate."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    # The worker pins its own JAX/XLA setup; drop the pytest session's.
+    base = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_"))
+    }
+
+    def run_once():
+        """One spawn/reap cycle. Returns [(returncode, output), ...];
+        every child is reaped (kill + communicate) on every path so a
+        hung or half-spawned pair never outlives the test."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # bind-then-close: small reuse race, retried below
+        procs = []
+        try:
+            for pid in range(2):
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, worker],
+                        env=dict(
+                            base,
+                            DOORMAN_COORDINATOR=f"127.0.0.1:{port}",
+                            DOORMAN_NUM_PROCESSES="2",
+                            DOORMAN_PROCESS_ID=str(pid),
+                        ),
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT,
+                        text=True,
+                    )
+                )
+            return [list(p.communicate(timeout=240)) + [p.returncode]
+                    for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()  # reap; drain the diagnostics pipe
+
+    results = run_once()
+    if any(rc != 0 for _, _, rc in results):
+        # The ephemeral coordinator port can be stolen between probe and
+        # bind (TOCTOU); one retry with a fresh port covers that flake.
+        results = run_once()
+    for pid, (out, _, rc) in enumerate(results):
+        assert rc == 0, f"worker {pid} failed:\n{out}"
+        assert "MULTIHOST WORKER OK" in out, out
