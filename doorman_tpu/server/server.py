@@ -421,19 +421,34 @@ class CapacityServer(CapacityServicer):
             )
         return self._resident_ok
 
-    def _resident_step(self, resources: List[Resource]) -> None:
+    def _resident_step(self, solver, resources: List[Resource],
+                       config_epoch: int) -> None:
         """One pipelined resident tick (runs in an executor thread; the
         native engine is mutex-guarded against concurrent RPC writes):
         collect the previous tick's grants, dispatch the next. Grants
         land one tick after their solve — the same freshness as a
-        client's refresh cadence."""
-        solver = self._resident_solver()
-        handle, self._resident_handle = self._resident_handle, None
-        if handle is not None:
-            solver.collect(handle)
-        self._resident_handle = solver.dispatch(
-            resources, self._config_epoch
-        )
+        client's refresh cadence.
+
+        `solver` is resolved by the CALLER on the event loop, together
+        with `resources` and `config_epoch`, so the three are mutually
+        consistent even when a mastership flip swaps the store engine
+        while this runs in the executor: the flip orphans the old
+        engine, and a step captured before it keeps writing to that
+        orphan (harmless) instead of mixing old rows into the new
+        engine. The in-flight handle is stored WITH its solver, and a
+        handle from any other solver instance is dropped, not
+        collected — its row ids belong to a different engine."""
+        entry, self._resident_handle = self._resident_handle, None
+        if entry is not None:
+            h_solver, handle = entry
+            if h_solver is solver:
+                solver.collect(handle)
+        handle = solver.dispatch(resources, config_epoch)
+        if self._resident is solver:
+            # A flip between the check and this assignment can still
+            # attach a stale entry; the identity check above makes that
+            # benign (the next step drops it uncollected).
+            self._resident_handle = (solver, handle)
 
     @property
     def _ticks_done(self) -> int:
@@ -488,10 +503,15 @@ class CapacityServer(CapacityServicer):
                 r for r in resources
                 if algo_kind_for(r.template) == AlgoKind.PRIORITY_BANDS
             ]
+            # Resolved HERE, on the event loop, so solver/resources/
+            # epoch stay mutually consistent under a concurrent
+            # mastership flip (see _resident_step).
+            resident = self._resident_solver()
+            epoch = self._config_epoch
 
             def resident_or_fallback():
                 try:
-                    self._resident_step(lane_res)
+                    self._resident_step(resident, lane_res, epoch)
                     if prio_res:
                         # PRIORITY_BANDS resources tick through the
                         # BatchSolver's priority part (group caps couple
@@ -544,6 +564,11 @@ class CapacityServer(CapacityServicer):
         while True:
             await asyncio.sleep(self.tick_interval)
             if not self.is_master:
+                # A flip's clear can race the executor attaching one
+                # last (stale) entry; no tick runs on a standby, so
+                # drop it here or it pins the orphaned engine and its
+                # device buffer for the whole standby period.
+                self._resident_handle = None
                 continue
             try:
                 await self.tick_once()

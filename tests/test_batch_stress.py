@@ -307,3 +307,68 @@ resources:
         await server.stop()
 
     asyncio.run(body())
+
+
+def test_mastership_flip_drops_stale_resident_handle():
+    """A mastership flip swaps the store engine mid-flight; a tick
+    handle produced by the PRE-flip resident solver must be dropped by
+    the next tick, never collected — its row ids belong to the orphaned
+    engine, and applying it would write pre-failover grants into the
+    fresh master's store (which must start empty, in learning). Pins
+    the solver-identity guard in CapacityServer._resident_step."""
+
+    async def body():
+        server = CapacityServer(
+            "flip", TrivialElection(), mode="batch", tick_interval=10.0,
+            minimum_refresh_interval=0.0, native_store=True,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(parse_yaml_config(CONFIG))
+        await asyncio.sleep(0)
+        addr = f"127.0.0.1:{port}"
+
+        async with grpc.aio.insecure_channel(addr) as ch:
+            stub = CapacityStub(ch)
+            for i in range(8):
+                req = pb.GetCapacityRequest(client_id=f"c{i}")
+                rr = req.resource.add()
+                rr.resource_id = "shared0"
+                rr.wants = 10.0
+                await stub.GetCapacity(req)
+        await server.tick_once()
+        await server.tick_once()
+        old_solver = server._resident
+        assert old_solver is not None
+
+        # The race under test: the executor thread finishes a dispatch
+        # with the OLD solver and attaches its handle AFTER the flip
+        # cleared the slot.
+        lane_res = list(server.resources.values())
+        stale = old_solver.dispatch(lane_res, server._config_epoch)
+        await server._on_is_master(False)
+        await server._on_is_master(True)
+        server._resident_handle = (old_solver, stale)
+
+        # A fresh client population on the fresh engine, then a tick:
+        # the stale handle must be dropped uncollected, and the new
+        # solver must be a new instance on the new engine.
+        async with grpc.aio.insecure_channel(addr) as ch:
+            stub = CapacityStub(ch)
+            for i in range(8):
+                req = pb.GetCapacityRequest(client_id=f"n{i}")
+                rr = req.resource.add()
+                rr.resource_id = "shared0"
+                rr.wants = 5.0
+                await stub.GetCapacity(req)
+        await server.tick_once()
+        assert stale.collected is False, (
+            "stale pre-flip handle was collected into the new engine"
+        )
+        assert server._resident is not None
+        assert server._resident is not old_solver
+        # And the pipeline keeps working on the new engine.
+        await server.tick_once()
+        assert server._resident.ticks >= 1
+        await server.stop()
+
+    asyncio.run(body())
