@@ -558,23 +558,17 @@ def _require_backend(timeout_s: float = 180.0) -> None:
     caller nothing; a clear error line and a non-zero exit do)."""
     import os
 
-    from doorman_tpu.utils.backend import probe_backend
+    from doorman_tpu.utils.backend import probe_backend_or_reason
 
-    devices, exc = probe_backend(timeout_s)
+    devices, reason = probe_backend_or_reason(timeout_s)
     if devices is None:
-        note = (
-            f"{type(exc).__name__}: {exc}"
-            if exc is not None
-            else "jax backend did not initialize within "
-            f"{timeout_s:.0f}s (device tunnel down?)"
-        )
         print(
             json.dumps(
                 {
                     "metric": "backend_unreachable",
                     "value": 0,
                     "unit": "error",
-                    "note": note,
+                    "note": reason,
                 }
             ),
             flush=True,

@@ -192,13 +192,11 @@ class EtcdGateway:
                     while True:
                         line = resp.readline()
                         if not line:
-                            self._watch_endpoint = i
-                            return True  # stream closed cleanly
+                            break  # stream closed
                         try:
                             frame = json.loads(line.decode())
                         except ValueError:
-                            self._watch_endpoint = i
-                            return True
+                            break  # not a watch stream (proxy error?)
                         established = True  # got a frame (creation ack)
                         result = frame.get("result", frame)
                         if result.get("events"):
@@ -206,13 +204,17 @@ class EtcdGateway:
                             return True  # the key changed
                         # else: keep waiting for an event frame
             except Exception:
-                if established:
-                    # Idle timeout on a live watch: healthy, just no
-                    # change within `timeout`.
-                    self._watch_endpoint = i
-                    return True
-                # Endpoint failed before the watch existed: start the
-                # next call (and the next iteration) past it.
-                self._watch_endpoint = (i + 1) % n
-                continue
+                pass  # timeout or transport failure; classified below
+            if established:
+                # Idle timeout, or a clean close after the creation
+                # ack: a live watch existed, just no change within
+                # `timeout`.
+                self._watch_endpoint = i
+                return True
+            # The endpoint never produced a watch frame — including a
+            # connectable endpoint whose stream closes instantly with
+            # an empty or non-JSON body (degenerate proxy). Pinning
+            # such an endpoint would make it permanently sticky; start
+            # the next call (and the next iteration) past it.
+            self._watch_endpoint = (i + 1) % n
         return False

@@ -35,3 +35,20 @@ def probe_backend(
     t.start()
     t.join(timeout_s)
     return result.get("devices"), result.get("exc")
+
+
+def probe_backend_or_reason(
+    timeout_s: float = 180.0,
+) -> Tuple[Optional[list], Optional[str]]:
+    """probe_backend plus the shared diagnostic line: (devices, None)
+    on success, (None, reason) on failure — so the bench and the entry
+    point render the identical message for the identical condition."""
+    devices, exc = probe_backend(timeout_s)
+    if devices is not None:
+        return devices, None
+    if exc is not None:
+        return None, f"{type(exc).__name__}: {exc}"
+    return None, (
+        f"jax backend did not initialize within {timeout_s:.0f}s "
+        "(device tunnel down?)"
+    )

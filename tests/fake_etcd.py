@@ -162,36 +162,41 @@ class FakeEtcd:
 
     def _txn(self, body: dict) -> dict:
         """Only the dialect the gateway client emits: a single compare
-        on CREATE == 0 guarding request_put ops."""
-        succeeded = True
-        for cmp in body.get("compare", []):
-            target = cmp.get("target")
-            key = _b64d(cmp["key"])
-            with self._lock:
-                self._sweep()
+        on CREATE == 0 guarding request_put ops. Compare and guarded
+        ops run under ONE lock acquisition — real etcd txns are atomic,
+        and the election integration tests exist to pin exactly the
+        mutual exclusion a split compare/put would break (two racing
+        put_if_absent calls both told they won)."""
+        with self._lock:
+            self._sweep()
+            succeeded = True
+            for cmp in body.get("compare", []):
+                target = cmp.get("target")
+                key = _b64d(cmp["key"])
                 entry = self._kv.get(key)
-            if target == "CREATE":
-                expected = int(cmp.get("create_revision", 0))
-                actual = entry[2] if entry else 0
-                ok = actual == expected
-            else:
-                raise ValueError(f"unhandled txn compare target {target}")
-            if cmp.get("result", "EQUAL") == "EQUAL":
-                succeeded = succeeded and ok
-            else:
-                succeeded = succeeded and not ok
-        ops = body.get("success" if succeeded else "failure", [])
-        responses = []
-        for op in ops:
-            put = op.get("request_put") or op.get("requestPut")
-            if put:
-                with self._lock:
+                if target == "CREATE":
+                    expected = int(cmp.get("create_revision", 0))
+                    actual = entry[2] if entry else 0
+                    ok = actual == expected
+                else:
+                    raise ValueError(
+                        f"unhandled txn compare target {target}"
+                    )
+                if cmp.get("result", "EQUAL") == "EQUAL":
+                    succeeded = succeeded and ok
+                else:
+                    succeeded = succeeded and not ok
+            ops = body.get("success" if succeeded else "failure", [])
+            responses = []
+            for op in ops:
+                put = op.get("request_put") or op.get("requestPut")
+                if put:
                     self._put(
                         _b64d(put["key"]),
                         _b64d(put["value"]),
                         int(put.get("lease", 0)),
                     )
-                responses.append({"response_put": {}})
+                    responses.append({"response_put": {}})
         return {"succeeded": succeeded, "responses": responses}
 
     def _watch(self, body: dict, handler) -> None:

@@ -332,3 +332,42 @@ def test_watch_walk_reaches_healthy_endpoint_between_dead_ones(fake):
     assert gw.wait_for_change("/k", timeout=2.0) is True
     # Subsequent calls start straight at the endpoint that worked.
     assert gw.endpoints[gw._watch_endpoint].endswith(fake.address)
+
+
+def test_degenerate_empty_close_endpoint_is_not_sticky(fake):
+    """An endpoint that answers /v3/watch with an instant empty 200
+    close never produced a watch frame; it must not be pinned as the
+    preferred watch endpoint (regression: a clean close BEFORE any
+    frame counted as 'established', making such an endpoint permanently
+    sticky and degrading the watch to a busy loop)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class EmptyClose(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.0"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()  # zero frames, instant close
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), EmptyClose)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    degenerate = f"127.0.0.1:{httpd.server_address[1]}"
+
+    gw = EtcdGateway([degenerate, fake.address])
+    gw.put("/k", "v0")
+    # The walk must advance past the frameless endpoint and establish
+    # on the healthy fake (idle timeout counts as established).
+    assert gw.wait_for_change("/k", timeout=1.5) is True
+    assert gw.endpoints[gw._watch_endpoint].endswith(fake.address)
+    # And it stays on the healthy endpoint on later calls.
+    assert gw.wait_for_change("/k", timeout=1.0) is True
+    assert gw.endpoints[gw._watch_endpoint].endswith(fake.address)
+    httpd.shutdown()
+    httpd.server_close()

@@ -64,3 +64,25 @@ def test_probe_backend_returns_devices_when_backend_is_up():
     devices, exc = probe_backend(timeout_s=60.0)
     assert exc is None
     assert devices  # the 8 virtual CPU devices
+
+
+def test_probe_backend_or_reason_happy_and_failure_messages():
+    """The shared diagnostic formatting the bench and entry point both
+    use: devices on success, a reason string naming the failure mode
+    otherwise."""
+    from doorman_tpu.utils import backend
+
+    devices, reason = backend.probe_backend_or_reason(timeout_s=60.0)
+    assert devices and reason is None
+
+    # Failure paths, via the underlying probe's two shapes.
+    orig = backend.probe_backend
+    try:
+        backend.probe_backend = lambda t: (None, ValueError("boom"))
+        _, reason = backend.probe_backend_or_reason(5.0)
+        assert reason == "ValueError: boom"
+        backend.probe_backend = lambda t: (None, None)
+        _, reason = backend.probe_backend_or_reason(5.0)
+        assert "did not initialize within 5s" in reason
+    finally:
+        backend.probe_backend = orig
