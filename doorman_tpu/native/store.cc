@@ -119,6 +119,14 @@ inline int64_t sweep_resource(ResourceStore &r, double now) {
   return removed;
 }
 
+// API convention: every extern entry point treats an out-of-range
+// resource handle as a no-op (skip / return 0 / zero-fill) — a
+// Python-level bookkeeping bug must degrade to a miss at this ctypes
+// boundary, never to an out-of-bounds access.
+inline bool valid_rid(const Engine *e, int32_t rid) {
+  return rid >= 0 && rid < static_cast<int32_t>(e->resources.size());
+}
+
 inline void mark_dirty(Engine *e, int32_t rid) {
   if (e->dirty_flags.size() < e->resources.size())
     e->dirty_flags.resize(e->resources.size(), 0);
@@ -200,6 +208,7 @@ int32_t dm_assign(Engine *e, int32_t rid, int64_t cid, double expiry,
                   double refresh_interval, double has, double wants,
                   int32_t subclients, int64_t priority) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   return upsert(e, rid, cid,
                 Lease{expiry, refresh_interval, has, wants, subclients,
                       priority});
@@ -216,9 +225,8 @@ int64_t dm_bulk_assign(Engine *e, const int32_t *rid, const int64_t *cid,
                        int64_t n) {
   std::lock_guard<std::mutex> lock(e->mu);
   int64_t assigned = 0;
-  const int32_t n_res = static_cast<int32_t>(e->resources.size());
   for (int64_t i = 0; i < n; ++i) {
-    if (rid[i] < 0 || rid[i] >= n_res) continue;
+    if (!valid_rid(e, rid[i])) continue;
     upsert(e, rid[i], cid[i],
            Lease{expiry[i], refresh[i], has[i], wants[i], subclients[i],
                  priority[i]});
@@ -235,6 +243,7 @@ int64_t dm_bulk_assign(Engine *e, const int32_t *rid, const int64_t *cid,
 // path). Returns 1 if the client held a lease, else 0.
 int32_t dm_regrant(Engine *e, int32_t rid, int64_t cid, double has) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
@@ -247,6 +256,7 @@ int32_t dm_regrant(Engine *e, int32_t rid, int64_t cid, double has) {
 // Returns 1 if the client held a lease (now removed), else 0.
 int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
@@ -259,6 +269,7 @@ int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
 // store); returns how many were removed.
 int64_t dm_clean(Engine *e, int32_t rid, double now) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   ResourceStore &r = e->resources[rid];
   const int64_t removed = sweep_resource(r, now);
   if (removed) mark_dirty(e, rid);
@@ -312,8 +323,7 @@ void dm_pack_rows(Engine *e, const int32_t *rids, int64_t n, int64_t K,
     double *h = has + i * K;
     double *s = sub + i * K;
     uint8_t *a = act + i * K;
-    if (rids[i] < 0 ||
-        rids[i] >= static_cast<int32_t>(e->resources.size())) {
+    if (!valid_rid(e, rids[i])) {
       std::fill(w, w + K, 0.0);
       std::fill(h, h + K, 0.0);
       std::fill(s, s + K, 0.0);
@@ -349,8 +359,7 @@ int64_t dm_band_aggregates(Engine *e, int32_t rid, int64_t *prio_out,
                            double *wants_out, int64_t *num_out,
                            int64_t cap) {
   std::lock_guard<std::mutex> lock(e->mu);
-  if (rid < 0 || rid >= static_cast<int32_t>(e->resources.size()))
-    return 0;
+  if (!valid_rid(e, rid)) return 0;
   const ResourceStore &r = e->resources[rid];
   // O(L) accumulate + O(B log B) sort: this runs under the engine
   // mutex for million-lease stores, so no per-lease band scan.
@@ -383,9 +392,8 @@ int64_t dm_bulk_refresh(Engine *e, const int32_t *rid, const int64_t *cid,
                         const double *wants, int64_t n) {
   std::lock_guard<std::mutex> lock(e->mu);
   int64_t refreshed = 0;
-  const int32_t n_res = static_cast<int32_t>(e->resources.size());
   for (int64_t i = 0; i < n; ++i) {
-    if (rid[i] < 0 || rid[i] >= n_res) continue;
+    if (!valid_rid(e, rid[i])) continue;
     ResourceStore &r = e->resources[rid[i]];
     auto it = r.index.find(cid[i]);
     if (it == r.index.end()) continue;
@@ -419,9 +427,7 @@ int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
   std::lock_guard<std::mutex> lock(e->mu);
   int64_t applied = 0;
   for (int64_t i = 0; i < n; ++i) {
-    if (rids[i] < 0 ||
-        rids[i] >= static_cast<int32_t>(e->resources.size()))
-      continue;
+    if (!valid_rid(e, rids[i])) continue;
     ResourceStore &r = e->resources[rids[i]];
     if (r.version != expected_version[i]) continue;
     if (!keep_has[i]) {
@@ -442,6 +448,10 @@ int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
 // out[0]=sum_has out[1]=sum_wants out[2]=subclient count out[3]=#leases
 void dm_sums(Engine *e, int32_t rid, double *out) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) {
+    out[0] = out[1] = out[2] = out[3] = 0.0;
+    return;
+  }
   const ResourceStore &r = e->resources[rid];
   out[0] = r.sum_has;
   out[1] = r.sum_wants;
@@ -453,6 +463,7 @@ void dm_sums(Engine *e, int32_t rid, double *out) {
 // subclients, priority}. Returns 1 if present, else 0 (out untouched).
 int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   const ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
@@ -472,6 +483,7 @@ int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,
                 double *refresh, double *has, double *wants,
                 int32_t *subclients, int64_t *priority, int64_t cap) {
   std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
   const ResourceStore &r = e->resources[rid];
   const int64_t n =
       std::min<int64_t>(cap, static_cast<int64_t>(r.leases.size()));
@@ -517,6 +529,7 @@ int64_t dm_pack(Engine *e, const int32_t *order, int32_t n_order,
   std::lock_guard<std::mutex> lock(e->mu);
   int64_t w = 0;
   for (int32_t i = 0; i < n_order; ++i) {
+    if (!valid_rid(e, order[i])) continue;
     const ResourceStore &r = e->resources[order[i]];
     const size_t n = r.leases.size();
     for (size_t j = 0; j < n; ++j) {
@@ -553,7 +566,7 @@ int64_t dm_apply(Engine *e, const int32_t *order, int32_t n_order,
   for (int64_t i = 0; i < n_edges; ++i) {
     applied_out[i] = 0;
     const int32_t seg = ridx[i];
-    if (seg < 0 || seg >= n_order || order[seg] < 0) continue;
+    if (seg < 0 || seg >= n_order || !valid_rid(e, order[seg])) continue;
     ResourceStore &r = e->resources[order[seg]];
     auto it = r.index.find(cid[i]);
     if (it == r.index.end()) continue;  // released mid-solve

@@ -383,3 +383,81 @@ def test_regrant_updates_has_without_dirtying_or_restamping():
     assert len(rids) == 0, "regrant dirtied the row"
     store.regrant("missing", 3.0)  # released mid-solve: no-op
     assert store.sum_has == 7.5
+
+
+def test_out_of_range_resource_handles_are_noops():
+    """Every extern entry point must treat an out-of-range resource
+    handle as a no-op (skip / return 0 / zero-fill), never as an
+    out-of-bounds read: the ctypes boundary should degrade a
+    Python-level bookkeeping bug into a miss, not memory corruption.
+    Exercised through raw lib calls with a handle the engine never
+    issued."""
+    import ctypes
+
+    import numpy as np
+
+    clock = FakeClock()
+    engine = native.StoreEngine(clock=clock)
+    store = engine.store("real")
+    store.assign("c0", 60.0, 5.0, 1.0, 2.0, 1)
+    lib, ptr = engine._lib, engine._ptr
+    bad = 999  # never issued by dm_resource
+
+    assert lib.dm_regrant(ptr, bad, 0, 5.0) == 0
+    assert lib.dm_assign(ptr, bad, 0, 60.0, 5.0, 1.0, 2.0, 1, 0) == 0
+    assert lib.dm_release(ptr, bad, 0) == 0
+    assert lib.dm_clean(ptr, bad, ctypes.c_double(1e18)) == 0
+    assert lib.dm_get(ptr, bad, 0, (ctypes.c_double * 6)()) == 0
+
+    sums = (ctypes.c_double * 4)(7.0, 7.0, 7.0, 7.0)
+    lib.dm_sums(ptr, bad, sums)
+    assert list(sums) == [0.0, 0.0, 0.0, 0.0]
+
+    out = (ctypes.c_int64 * 4)()
+    assert lib.dm_dump(
+        ptr, bad, out, (ctypes.c_double * 4)(), (ctypes.c_double * 4)(),
+        (ctypes.c_double * 4)(), (ctypes.c_double * 4)(),
+        (ctypes.c_int32 * 4)(), (ctypes.c_int64 * 4)(), 4
+    ) == 0
+
+    # dm_pack skips out-of-range order entries but keeps packing the
+    # valid ones (segment ids still index the order array).
+    order = np.array([bad, store._rid], np.int32)
+    ridx = np.empty(4, np.int32)
+    cid = np.empty(4, np.int64)
+    w = np.empty(4, np.float64)
+    h = np.empty(4, np.float64)
+    s = np.empty(4, np.float64)
+    p = np.empty(4, np.int64)
+    n = lib.dm_pack(
+        ptr, order.ctypes.data_as(native._I32P), 2,
+        ridx.ctypes.data_as(native._I32P),
+        cid.ctypes.data_as(native._I64P),
+        w.ctypes.data_as(native._F64P), h.ctypes.data_as(native._F64P),
+        s.ctypes.data_as(native._F64P), p.ctypes.data_as(native._I64P),
+        4,
+    )
+    assert n == 1 and int(ridx[0]) == 1 and w[0] == 2.0
+
+    # dm_apply: an edge whose segment maps to an out-of-range handle
+    # is skipped (order[] upper bound), valid edges still apply.
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ridx_a = np.array([0, 1], np.int32)
+    cid_a = np.array([0, engine.client_handle("c0")], np.int64)
+    gets = np.array([9.0, 3.5], np.float64)
+    keep = np.zeros(2, np.uint8)
+    applied_flags = np.zeros(2, np.uint8)
+    applied = lib.dm_apply(
+        ptr, order.ctypes.data_as(native._I32P), 2,
+        ridx_a.ctypes.data_as(native._I32P),
+        cid_a.ctypes.data_as(native._I64P),
+        gets.ctypes.data_as(native._F64P), 2,
+        keep.ctypes.data_as(u8p),
+        applied_flags.ctypes.data_as(u8p),
+    )
+    assert applied == 1
+    assert list(applied_flags) == [0, 1]
+    assert store.get("c0").has == 3.5
+
+    # And the real store's demand is untouched by all of the above.
+    assert store.sum_wants == 2.0 and len(store) == 1
