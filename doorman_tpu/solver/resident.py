@@ -81,7 +81,7 @@ class TickHandle:
     needs to write it back. out=None marks an idle tick (nothing to
     download or apply)."""
 
-    out: object  # device array [Sb, kfill], download pending
+    out: object  # list of device slices of [Sb, kfill], copies in flight
     sel_rows: np.ndarray  # [n_sel] row indices (unique)
     rids: np.ndarray  # [n_sel] engine resource handles
     versions: np.ndarray  # [n_sel] membership epochs at upload
@@ -581,8 +581,18 @@ class ResidentDenseSolver:
             idx_d, a_w_d, f_block_d, f_act_d,
             self._cap_d, self._kind_d, self._learn_d, self._statc_d,
         )
+        # Start the grant download as SEVERAL async streams: the
+        # tunneled device link only reaches full bandwidth with
+        # overlapping copies in flight, and a single whole-slab copy
+        # would serialize the download behind one round-trip. The split
+        # costs a few small on-device slice allocations (measured:
+        # ~halves the download lap and tightens the tick's p90).
+        from doorman_tpu.utils.transfer import split_for_download
+
+        out = split_for_download(out)
         try:
-            out.copy_to_host_async()
+            for part in out:
+                part.copy_to_host_async()
         except Exception:
             pass
         lap("launch")
@@ -602,7 +612,7 @@ class ResidentDenseSolver:
         next tick). Returns the rows applied."""
         import jax
 
-        from doorman_tpu.utils.transfer import chunked_device_get
+        from doorman_tpu.utils.transfer import land_parts
 
         if handle.collected:
             return 0
@@ -615,7 +625,9 @@ class ResidentDenseSolver:
             self.last_tick_seconds = self._clock() - handle.dispatched_at
             return 0
         t0 = time.perf_counter()
-        gets = chunked_device_get(handle.out)
+        # Parts were split (and their async copies started) at
+        # dispatch; land them in order into one buffer.
+        gets = land_parts(handle.out)
         gets = np.asarray(gets, np.float64)[: handle.n_sel]
         t1 = time.perf_counter()
         self.phase_s["download"] = (

@@ -11,6 +11,47 @@ from __future__ import annotations
 import numpy as np
 
 
+def split_for_download(
+    arr, *, chunks: "int | None" = None, min_bytes: int = 1 << 17
+) -> list:
+    """Split a device array into leading-axis slices for an overlapped
+    download whose async copies the CALLER starts (use when the copy
+    should begin well before the consuming `device_get`, e.g. at
+    dispatch time in a pipelined tick). Always returns a list — length
+    1 when splitting cannot help. chunks=None sizes the stream count
+    to the array (one per ~256 KB, between 2 and 8).
+
+    Each slice is an XLA slice op producing its own (small) device
+    buffer — NOT a view — so the split costs one dispatch and a
+    transient allocation per part; the bytes crossing the host link
+    are unchanged."""
+    nbytes = getattr(arr, "nbytes", 0)
+    ndim = getattr(arr, "ndim", 0)
+    if chunks is None:
+        chunks = int(min(8, max(2, nbytes >> 18)))
+    if ndim < 1 or nbytes < min_bytes or arr.shape[0] < chunks:
+        return [arr]
+    bounds = np.linspace(0, arr.shape[0], chunks + 1).astype(int)
+    return [arr[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+def land_parts(parts: list) -> np.ndarray:
+    """Land `split_for_download` parts into one contiguous ndarray
+    (preallocated — no per-part concatenate copy)."""
+    import jax
+
+    if len(parts) == 1:
+        return jax.device_get(parts[0])
+    lead = sum(int(p.shape[0]) for p in parts)
+    out = np.empty((lead,) + tuple(parts[0].shape[1:]), parts[0].dtype)
+    pos = 0
+    for p in parts:
+        n = int(p.shape[0])
+        out[pos : pos + n] = jax.device_get(p)
+        pos += n
+    return out
+
+
 def chunked_device_get(
     arr, *, chunks: int = 8, min_bytes: int = 1 << 20
 ) -> np.ndarray:
@@ -19,17 +60,8 @@ def chunked_device_get(
     Small arrays (< min_bytes) and scalars take the plain path; the
     split is along axis 0. Returns one contiguous ndarray either way.
     """
-    import jax
-
-    nbytes = getattr(arr, "nbytes", 0)
-    ndim = getattr(arr, "ndim", 0)
-    if ndim < 1 or nbytes < min_bytes or arr.shape[0] < chunks:
-        return jax.device_get(arr)
-    bounds = np.linspace(0, arr.shape[0], chunks + 1).astype(int)
-    parts = [arr[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
-    for p in parts:
-        p.copy_to_host_async()
-    out = np.empty(arr.shape, arr.dtype)
-    for p, a, b in zip(parts, bounds[:-1], bounds[1:]):
-        out[a:b] = jax.device_get(p)
-    return out
+    parts = split_for_download(arr, chunks=chunks, min_bytes=min_bytes)
+    if len(parts) > 1:
+        for p in parts:
+            p.copy_to_host_async()
+    return land_parts(parts)

@@ -86,3 +86,24 @@ def test_probe_backend_or_reason_happy_and_failure_messages():
         assert "did not initialize within 5s" in reason
     finally:
         backend.probe_backend = orig
+
+
+def test_split_for_download_thresholds():
+    """Small or low-rank arrays pass through; big ones split into
+    leading-axis views that cover the array exactly."""
+    import numpy as np
+
+    from doorman_tpu.utils.transfer import split_for_download
+
+    small = np.zeros((8, 8), np.float32)
+    assert split_for_download(small) == [small]
+    assert len(split_for_download(np.float32(3.0))) == 1  # scalar path
+
+    big = np.arange(2 * (1 << 17), dtype=np.float32).reshape(-1, 64)
+    parts = split_for_download(big)
+    assert len(parts) == 4  # ~256 KB per stream at 1 MB
+    np.testing.assert_array_equal(np.concatenate(parts), big)
+
+    from doorman_tpu.utils.transfer import land_parts
+
+    np.testing.assert_array_equal(land_parts(parts), big)
