@@ -166,6 +166,8 @@ def main() -> None:
     # Steady-state pipelined ticks: churn uploads for the next
     # UPLOAD_LOOKAHEAD ticks are staged while earlier ticks solve, and up
     # to PIPELINE_DEPTH grant downloads trail the solves.
+    from doorman_tpu.utils.transfer import land_parts, start_download
+
     def run_once():
         wants_d = put(wants0)
         gets_d = put(np.zeros((R, K), dtype))
@@ -180,12 +182,13 @@ def main() -> None:
                     )
             idx, rows, ridx = staged.pop(t)
             wants_d, gets_d, out = tick(wants_d, gets_d, idx, rows, ridx)
-            out.copy_to_host_async()
-            in_flight.append(out)
+            # Several async copy streams per slab (the link needs
+            # overlapping copies in flight to reach full bandwidth).
+            in_flight.append(start_download(out))
             if len(in_flight) >= PIPELINE_DEPTH:
-                jax.device_get(in_flight.pop(0))
-        for out in in_flight:
-            jax.device_get(out)
+                land_parts(in_flight.pop(0))
+        for parts in in_flight:
+            land_parts(parts)
         return time.perf_counter() - start
 
     per_tick_ms = sorted(

@@ -587,14 +587,9 @@ class ResidentDenseSolver:
         # would serialize the download behind one round-trip. The split
         # costs a few small on-device slice allocations (measured:
         # ~halves the download lap and tightens the tick's p90).
-        from doorman_tpu.utils.transfer import split_for_download
+        from doorman_tpu.utils.transfer import start_download
 
-        out = split_for_download(out)
-        try:
-            for part in out:
-                part.copy_to_host_async()
-        except Exception:
-            pass
+        out = start_download(out)
         lap("launch")
         return TickHandle(
             out=out,
@@ -610,8 +605,6 @@ class ResidentDenseSolver:
         """Write one tick's downloaded grants back into the engine; rows
         whose membership moved mid-flight are skipped (they re-deliver
         next tick). Returns the rows applied."""
-        import jax
-
         from doorman_tpu.utils.transfer import land_parts
 
         if handle.collected:

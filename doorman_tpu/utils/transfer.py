@@ -52,6 +52,20 @@ def land_parts(parts: list) -> np.ndarray:
     return out
 
 
+def start_download(arr, *, chunks: "int | None" = None,
+                   min_bytes: int = 1 << 17) -> list:
+    """Split `arr` for an overlapped download AND start the async
+    copies; pair with `land_parts` to consume. Failure to start a copy
+    is non-fatal (numpy/fake-backend arrays land synchronously)."""
+    parts = split_for_download(arr, chunks=chunks, min_bytes=min_bytes)
+    try:
+        for p in parts:
+            p.copy_to_host_async()
+    except Exception:
+        pass
+    return parts
+
+
 def chunked_device_get(
     arr, *, chunks: int = 8, min_bytes: int = 1 << 20
 ) -> np.ndarray:
@@ -60,8 +74,9 @@ def chunked_device_get(
     Small arrays (< min_bytes) and scalars take the plain path; the
     split is along axis 0. Returns one contiguous ndarray either way.
     """
-    parts = split_for_download(arr, chunks=chunks, min_bytes=min_bytes)
-    if len(parts) > 1:
-        for p in parts:
-            p.copy_to_host_async()
-    return land_parts(parts)
+    if getattr(arr, "nbytes", 0) < min_bytes:
+        import jax
+
+        return jax.device_get(arr)
+    return land_parts(start_download(arr, chunks=chunks,
+                                     min_bytes=min_bytes))
