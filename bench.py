@@ -563,7 +563,7 @@ def _require_backend(timeout_s: float = 180.0) -> None:
 
     from doorman_tpu.utils.backend import probe_backend_or_reason
 
-    devices, reason = probe_backend_or_reason(timeout_s)
+    devices, reason, _exc = probe_backend_or_reason(timeout_s)
     if devices is None:
         print(
             json.dumps(

@@ -39,16 +39,18 @@ def probe_backend(
 
 def probe_backend_or_reason(
     timeout_s: float = 180.0,
-) -> Tuple[Optional[list], Optional[str]]:
-    """probe_backend plus the shared diagnostic line: (devices, None)
-    on success, (None, reason) on failure — so the bench and the entry
-    point render the identical message for the identical condition."""
+) -> Tuple[Optional[list], Optional[str], Optional[BaseException]]:
+    """probe_backend plus the shared diagnostic line:
+    (devices, None, None) on success, (None, reason, exc) on failure —
+    the bench and the entry point render the identical message for the
+    identical condition, and raisers chain `exc` so the original
+    backend traceback survives."""
     devices, exc = probe_backend(timeout_s)
     if devices is not None:
-        return devices, None
+        return devices, None, None
     if exc is not None:
-        return None, f"{type(exc).__name__}: {exc}"
+        return None, f"{type(exc).__name__}: {exc}", exc
     return None, (
         f"jax backend did not initialize within {timeout_s:.0f}s "
         "(device tunnel down?)"
-    )
+    ), None

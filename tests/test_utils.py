@@ -72,18 +72,19 @@ def test_probe_backend_or_reason_happy_and_failure_messages():
     otherwise."""
     from doorman_tpu.utils import backend
 
-    devices, reason = backend.probe_backend_or_reason(timeout_s=60.0)
-    assert devices and reason is None
+    devices, reason, exc = backend.probe_backend_or_reason(timeout_s=60.0)
+    assert devices and reason is None and exc is None
 
     # Failure paths, via the underlying probe's two shapes.
     orig = backend.probe_backend
     try:
-        backend.probe_backend = lambda t: (None, ValueError("boom"))
-        _, reason = backend.probe_backend_or_reason(5.0)
-        assert reason == "ValueError: boom"
+        boom = ValueError("boom")
+        backend.probe_backend = lambda t: (None, boom)
+        _, reason, exc = backend.probe_backend_or_reason(5.0)
+        assert reason == "ValueError: boom" and exc is boom
         backend.probe_backend = lambda t: (None, None)
-        _, reason = backend.probe_backend_or_reason(5.0)
-        assert "did not initialize within 5s" in reason
+        _, reason, exc = backend.probe_backend_or_reason(5.0)
+        assert "did not initialize within 5s" in reason and exc is None
     finally:
         backend.probe_backend = orig
 
