@@ -400,3 +400,42 @@ def test_not_master_redirects_client():
             await server.stop()
 
     run(body())
+
+
+def test_redirect_loop_between_two_non_masters_is_bounded():
+    """Two servers each pointing at the other as master must not spin
+    the connection in an unbounded sleepless redirect chase: after the
+    bounded number of immediate redirects the attempt fails, and with
+    max_retries exhausted execute() raises MasterUnknown (reference
+    runMasterAware's redirect loop, connection.go:143-227)."""
+
+    async def body():
+        from doorman_tpu.client.connection import MasterUnknown
+
+        a, addr_a = await make_server()
+        b, addr_b = await make_server()
+        conn = None
+        try:
+            a.is_master = False
+            a.current_master = addr_b
+            b.is_master = False
+            b.current_master = addr_a
+            conn = Connection(addr_a, max_retries=1)
+            # wait_for makes a broken redirect bound FAIL crisply
+            # instead of hanging the suite on an endless chase.
+            with pytest.raises(MasterUnknown):
+                await asyncio.wait_for(
+                    conn.execute(
+                        lambda stub: stub.GetCapacity(
+                            capacity_request("c1", "proportional", 5.0)
+                        )
+                    ),
+                    timeout=30.0,
+                )
+        finally:
+            if conn is not None:
+                await conn.close()
+            await a.stop()
+            await b.stop()
+
+    run(body())
