@@ -1,0 +1,50 @@
+"""Documentation examples stay executable: every YAML resource-repository
+block in doc/*.md and README.md must load through the real config
+parser — an example a user cannot paste verbatim is a doc bug (found
+live: the capacity-group example shipped without the mandatory "*"
+entry). A block demonstrating a REJECTED config opts out explicitly
+with an `<!-- invalid -->` comment right before the fence."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.server.config import ConfigError, parse_yaml_config
+
+_ROOT = Path(__file__).parent.parent
+DOCS = sorted((_ROOT / "doc").glob("*.md")) + [_ROOT / "README.md"]
+
+
+def yaml_blocks():
+    for path in DOCS:
+        text = path.read_text()
+        for m in re.finditer(r"```ya?ml\n(.*?)```", text, re.S):
+            block = m.group(1)
+            if "resources" not in block:
+                continue  # not a repository document (compose files etc.)
+            # Deterministic opt-out: an example meant to be rejected
+            # carries an explicit marker right before its fence.
+            context = text[max(0, m.start() - 120):m.start()]
+            expect_invalid = "<!-- invalid -->" in context
+            yield pytest.param(
+                block, expect_invalid,
+                id=f"{path.name}:{text[:m.start()].count(chr(10)) + 1}",
+            )
+
+
+@pytest.mark.parametrize("block,expect_invalid", list(yaml_blocks()))
+def test_doc_config_examples_load(block, expect_invalid):
+    if expect_invalid:
+        with pytest.raises(ConfigError):
+            parse_yaml_config(block)
+    else:
+        parse_yaml_config(block)
+
+
+def test_docs_have_config_examples():
+    # The sweep must actually cover something; an accidental regex or
+    # layout change silently skipping every block would pass vacuously.
+    assert len(list(yaml_blocks())) >= 3
