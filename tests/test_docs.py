@@ -48,3 +48,34 @@ def test_docs_have_config_examples():
     # The sweep must actually cover something; an accidental regex or
     # layout change silently skipping every block would pass vacuously.
     assert len(list(yaml_blocks())) >= 3
+
+
+def test_deploy_manifests_parse_and_reference_real_entrypoints():
+    """The deployment artifacts stay loadable and point at modules that
+    actually exist: compose/k8s files rot silently otherwise (nothing
+    else in CI reads them)."""
+    import importlib
+
+    import yaml
+
+    deploy = _ROOT / "deploy"
+    files = [deploy / "docker-compose.yml", deploy / "prometheus.yml"]
+    files += sorted((deploy / "k8s").glob("*.yaml"))
+    commands = set()
+    for f in files:
+        text = f.read_text()
+        docs = [d for d in yaml.safe_load_all(text) if d]
+        assert docs, f"{f} parsed to nothing"
+        for m in re.finditer(r"doorman_tpu\.[a-z0-9_.]+", text):
+            commands.add(m.group(0).rstrip("."))
+    # The Dockerfile references entrypoints too (CMD, comments) and
+    # nothing else in CI reads it.
+    for m in re.finditer(
+        r"doorman_tpu\.[a-z0-9_.]+", (deploy / "Dockerfile").read_text()
+    ):
+        commands.add(m.group(0).rstrip("."))
+    # The server config shipped for the compose stack must validate.
+    parse_yaml_config((deploy / "config.yml").read_text())
+    assert commands, "no doorman_tpu entrypoints referenced in deploy/"
+    for mod in sorted(commands):
+        importlib.import_module(mod)
