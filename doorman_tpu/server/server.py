@@ -142,6 +142,8 @@ class CapacityServer(CapacityServicer):
         self._parent_conn = None  # created lazily (import cycle + testing)
         self._tasks: List[asyncio.Task] = []
         self._solver = None
+        # At most one tick in flight (see tick_once).
+        self._tick_lock = asyncio.Lock()
         # Device-resident tick path (native batch servers without
         # priority-band resources): solver, its in-flight tick, and the
         # cached eligibility decision.
@@ -469,7 +471,19 @@ class CapacityServer(CapacityServicer):
         engine is mutex-guarded, so RPC handlers never wait on more
         than one engine call). Python stores: snapshot packing and
         write-back stay on the event loop (atomic w.r.t. handlers);
-        only the device solve leaves it."""
+        only the device solve leaves it.
+
+        Serialized: two ticks in flight would race the resident
+        solver's donated device tables (an XLA donated buffer is
+        consumed by its first use — the second tick dies with
+        InvalidArgument) and interleave the snapshot/apply phases. The
+        server's own loop never overlaps calls, but tick_once is also
+        driven directly by tests and operational tooling, and a manual
+        tick racing the loop's must queue, not corrupt."""
+        async with self._tick_lock:
+            await self._tick_once_locked()
+
+    async def _tick_once_locked(self) -> None:
         if not self.resources:
             return
         solver = self._get_solver()

@@ -372,3 +372,38 @@ def test_mastership_flip_drops_stale_resident_handle():
         await server.stop()
 
     asyncio.run(body())
+
+
+def test_concurrent_tick_once_calls_serialize():
+    """tick_once driven directly (tests, tooling) can race the server's
+    own tick loop; overlapping ticks would consume the resident
+    solver's donated device buffers twice (XLA InvalidArgument) and
+    interleave snapshot/apply. They must queue instead: N concurrent
+    calls all complete and each runs a full tick."""
+
+    async def body():
+        server = CapacityServer(
+            "serial", TrivialElection(), mode="batch", tick_interval=60.0,
+            minimum_refresh_interval=0.0, native_store=True,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(parse_yaml_config(CONFIG))
+        await asyncio.sleep(0)
+        addr = f"127.0.0.1:{port}"
+        async with grpc.aio.insecure_channel(addr) as ch:
+            stub = CapacityStub(ch)
+            for i in range(8):
+                req = pb.GetCapacityRequest(client_id=f"c{i}")
+                rr = req.resource.add()
+                rr.resource_id = "shared0"
+                rr.wants = 10.0
+                await stub.GetCapacity(req)
+        before = server._ticks_done
+        await asyncio.gather(*(server.tick_once() for _ in range(5)))
+        # Every call ran one full (serialized) tick; the pipelined
+        # resident path counts a tick at each collect, so at least the
+        # calls minus the pipeline's one in-flight handle must land.
+        assert server._ticks_done >= before + 4
+        await server.stop()
+
+    asyncio.run(body())
