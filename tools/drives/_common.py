@@ -1,0 +1,84 @@
+"""Shared plumbing for the validation drives: repo-rooted subprocess
+spawning with file-backed logs (a PIPE nobody drains blocks the child
+once the OS buffer fills), teardown, and the 1M-lease bulk loader."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, REPO)
+
+NUM_RES, PER_RES = 10_000, 100
+
+
+def spawn(args, name="proc"):
+    """Start a child with stdout+stderr appended to a temp log file
+    (returned alongside, for tailing on failure)."""
+    log = tempfile.NamedTemporaryFile(
+        "w+", suffix=f".{name}.log", delete=False
+    )
+    proc = subprocess.Popen(
+        args, cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True
+    )
+    proc._drive_log = log.name  # type: ignore[attr-defined]
+    return proc
+
+
+def tail(proc, n=2000) -> str:
+    path = getattr(proc, "_drive_log", None)
+    if not path or not os.path.exists(path):
+        return "<no log>"
+    with open(path) as f:
+        return f.read()[-n:]
+
+
+def stop(proc) -> None:
+    proc.terminate()
+    try:
+        proc.wait(5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+    path = getattr(proc, "_drive_log", None)
+    if path and os.path.exists(path):
+        os.unlink(path)
+
+
+def write_config(body: str) -> str:
+    cfg = tempfile.NamedTemporaryFile("w", suffix=".yml", delete=False)
+    cfg.write(body)
+    cfg.close()
+    return cfg.name
+
+
+def load_1m(server, seed: int = 1):
+    """Register NUM_RES resources on `server` and bulk-load
+    NUM_RES*PER_RES leases straight through its native engine (the
+    store the server itself serves from). Returns (rids, cids)."""
+    import numpy as np
+
+    engine = server._store_factory.__self__
+    rng = np.random.default_rng(seed)
+    n = NUM_RES * PER_RES
+    rids = np.empty(n, np.int32)
+    for r in range(NUM_RES):
+        res = server.get_or_create_resource(f"res{r}")
+        rids[r * PER_RES : (r + 1) * PER_RES] = res.store._rid
+    cids = np.array(
+        [engine.client_handle(f"c{i}") for i in range(n)], np.int64
+    )
+    engine.bulk_assign(
+        rids,
+        cids,
+        np.full(n, time.time() + 600.0),
+        np.full(n, 16.0),
+        np.zeros(n),
+        rng.integers(1, 100, n).astype(np.float64),
+        np.ones(n, np.int32),
+    )
+    return rids, cids
