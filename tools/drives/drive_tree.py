@@ -30,10 +30,10 @@ resources:
       learning_mode_duration: 0
 """)
 
-ROOT, INTER = 15710, 15711
+ROOT, INTER, ROOT_DEBUG = 15710, 15711, 15760
 root = spawn(
     [sys.executable, "-m", "doorman_tpu.cmd.server",
-     "--port", str(ROOT), "--debug-port", "15760",
+     "--port", str(ROOT), "--debug-port", str(ROOT_DEBUG),
      "--mode", "batch", "--native-store", "--tick-interval", "0.4",
      "--config", f"file:{cfg}",
      "--server-id", f"127.0.0.1:{ROOT}"],
@@ -68,21 +68,29 @@ async def main():
             resources.append(await c.resource("shared", wants=40.0))
 
         # Converge: demand 800 > root cap 400; the intermediate's total
-        # outgrant must approach and never exceed its parent lease.
-        deadline = time.time() + 60
-        total = 0.0
+        # outgrant must reach (essentially) its full parent lease and
+        # HOLD there — two consecutive stable samples, so neither a
+        # tree stuck below the lease nor a momentary pass-through of a
+        # later oversubscription satisfies the check.
+        deadline = time.time() + 90
+        total, stable = 0.0, 0
         while time.time() < deadline:
             await asyncio.sleep(2)
             assert inter.poll() is None, tail(inter)
+            assert root.poll() is None, tail(root)
             total = sum(r.current_capacity() for r in resources)
-            if total >= 350.0:
-                break
+            if 396.0 <= total <= 404.0:
+                stable += 1
+                if stable >= 2:
+                    break
+            else:
+                stable = 0
         print(f"intermediate outgrants total: {total:.1f} (root cap 400)")
-        assert 350.0 <= total <= 404.0, total
+        assert stable >= 2, f"did not hold at the parent lease: {total}"
 
         # The root must carry the intermediate's demand as sub-leases.
         with urllib.request.urlopen(
-            f"http://127.0.0.1:15760/debug/resources?resource=shared",
+            f"http://127.0.0.1:{ROOT_DEBUG}/debug/resources?resource=shared",
             timeout=5,
         ) as r:
             page = r.read().decode()
