@@ -82,3 +82,32 @@ def load_1m(server, seed: int = 1):
         np.ones(n, np.int32),
     )
     return rids, cids
+
+
+def require_backend(timeout_s: float = 180.0) -> None:
+    """Fail fast (exit 2) when the device backend cannot come up —
+    the tunneled TPU goes down periodically, and a drive hanging at
+    its first device op tells the operator nothing. The probe runs in
+    a THROWAWAY subprocess: TPU runtimes grant one process exclusive
+    device access, so probing in this (parent) process would hold the
+    chip and starve the servers the drives spawn. Call BEFORE spawning
+    anything, so a backend-down exit leaks no children."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"DEVICE BACKEND UNAVAILABLE: no backend init within "
+            f"{timeout_s:.0f}s (device tunnel down?)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    if proc.returncode != 0 or "ok" not in proc.stdout:
+        print(
+            "DEVICE BACKEND UNAVAILABLE: "
+            + (proc.stderr.strip()[-500:] or f"rc={proc.returncode}"),
+            file=sys.stderr,
+        )
+        raise SystemExit(2)

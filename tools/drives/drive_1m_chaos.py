@@ -6,7 +6,7 @@ import asyncio
 import sys
 import time
 
-from _common import load_1m
+from _common import require_backend, load_1m
 
 CFG = """
 resources:
@@ -96,4 +96,5 @@ async def main():
     await server.stop()
 
 
+require_backend()
 asyncio.run(main())

@@ -9,9 +9,11 @@ import subprocess
 import sys
 import time
 
-from _common import REPO, spawn, stop, tail, write_config
+from _common import require_backend, REPO, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
+
+require_backend()
 
 blackhole = socket.socket()
 blackhole.bind(("127.0.0.1", 0))

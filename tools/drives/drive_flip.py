@@ -7,9 +7,11 @@ import subprocess
 import sys
 import time
 
-from _common import REPO, spawn, stop, tail, write_config
+from _common import require_backend, REPO, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
+
+require_backend()
 
 fake = FakeEtcd()
 fake.start()

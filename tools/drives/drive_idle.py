@@ -1,12 +1,11 @@
 """Idle fast path at 1M on the chip: after two quiet rotations, ticks
 must cost no device work (microseconds, idle_ticks climbing)."""
-import asyncio, sys, time
-import numpy as np
-import os
-REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
-sys.path.insert(0, REPO)
+import asyncio
+import time
 
-NUM_RES, PER_RES = 10_000, 100
+import numpy as np
+
+from _common import NUM_RES, PER_RES, require_backend
 
 async def main():
     from doorman_tpu import native
@@ -60,4 +59,5 @@ async def main():
     assert solver.idle_ticks == before, "write did not resume real ticks"
     print("IDLE 1M OK")
 
+require_backend()
 asyncio.run(main())

@@ -6,7 +6,9 @@ import sys
 import time
 import urllib.request
 
-from _common import spawn as _spawn, stop, tail, write_config
+from _common import require_backend, spawn as _spawn, stop, tail, write_config
+
+require_backend()
 
 cfg = write_config("""
 resources:

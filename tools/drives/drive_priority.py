@@ -5,7 +5,9 @@ import os
 import sys
 import time
 
-from _common import spawn, stop, tail, write_config
+from _common import require_backend, spawn, stop, tail, write_config
+
+require_backend()
 
 cfg = write_config("""
 groups:
