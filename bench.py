@@ -554,17 +554,26 @@ SERVER_WARMUP = 6
 TICKS_SERVER = 24
 
 
-def _require_backend(timeout_s: float = 180.0) -> None:
-    """Fail fast with a diagnostic JSON line when the device backend
-    does not come up (the tunneled TPU can go unreachable, in which
-    case jax.devices() blocks forever — a hung bench run tells the
-    caller nothing; a clear error line and a non-zero exit do)."""
+def _require_backend() -> None:
+    """Gate the timed runs on the backend, riding out device-tunnel
+    blips. All probing happens in THROWAWAY subprocesses BEFORE any
+    in-process jax use: an in-process probe that hangs on a dead
+    tunnel leaves a stuck init thread that can later race the real
+    work (and the recovery probes) for exclusive device access, so
+    this process touches jax only once a fresh probe has succeeded —
+    its own init then starts clean. Costs one extra (seconds-scale)
+    backend init on the happy path; on failure it emits the waiter's
+    actual reason as a diagnostic JSON line and exits non-zero — a
+    hung bench run tells the caller nothing."""
     import os
 
-    from doorman_tpu.utils.backend import probe_backend_or_reason
+    from doorman_tpu.utils.backend import wait_for_backend
 
-    devices, reason, _exc = probe_backend_or_reason(timeout_s)
-    if devices is None:
+    reason = wait_for_backend(
+        attempts=3, per_timeout_s=120.0,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if reason is not None:
         print(
             json.dumps(
                 {

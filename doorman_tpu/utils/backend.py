@@ -1,56 +1,79 @@
 """Backend initialization watchdog.
 
 jax.devices() blocks forever when the tunneled device backend is
-unreachable; callers that must not hang (the bench, the driver's entry
-compile-check) probe it on a daemon thread with a deadline instead.
-One shared implementation so the bench and the entry point cannot
-drift.
+unreachable, and an in-process probe that hangs leaves a stuck init
+thread that can race later device work for exclusive access. Callers
+that must not hang (the bench, the driver's entry compile-check, the
+tools/drives scripts) therefore probe in THROWAWAY subprocesses with a
+deadline BEFORE any in-process jax use, waiting out transient tunnel
+blips on a paced retry schedule. One shared implementation so the
+callers cannot drift.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Optional, Tuple
+import time
+from typing import Optional
 
 
-def probe_backend(
-    timeout_s: float = 180.0,
-) -> Tuple[Optional[list], Optional[BaseException]]:
-    """Initialize jax's default backend with a deadline.
+def wait_for_backend(
+    attempts: int = 3,
+    per_timeout_s: float = 180.0,
+    cwd: Optional[str] = None,
+) -> Optional[str]:
+    """Wait out a device-tunnel blip: probe the backend in a THROWAWAY
+    subprocess every attempt (a fresh process re-initializes JAX, so a
+    tunnel that recovered mid-wait is actually picked up — an
+    in-process jax.devices() that began during the outage may be stuck
+    or have cached the failure). Attempts are paced to the full
+    per-attempt window even when a probe fails fast (connection
+    refused), so the total wait genuinely spans ~attempts*per_timeout
+    seconds of wall clock; per_timeout_s defaults to the full single
+    window a slow-but-healthy cold init can legitimately need. Returns
+    None once a probe succeeds, else the last failure reason. Progress
+    goes to stderr so a long wait is visibly a wait."""
+    import subprocess
+    import sys
 
-    Returns (devices, None) on success, (None, exception) when
-    initialization failed fast, and (None, None) when it timed out —
-    the abandoned daemon thread keeps blocking harmlessly."""
-    result: dict = {}
-
-    def probe():
+    reason = "backend probe never ran"
+    for attempt in range(1, attempts + 1):
+        attempt_start = time.monotonic()
         try:
-            import jax
-
-            result["devices"] = jax.devices()
-        except Exception as e:
-            result["exc"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return result.get("devices"), result.get("exc")
-
-
-def probe_backend_or_reason(
-    timeout_s: float = 180.0,
-) -> Tuple[Optional[list], Optional[str], Optional[BaseException]]:
-    """probe_backend plus the shared diagnostic line:
-    (devices, None, None) on success, (None, reason, exc) on failure —
-    the bench and the entry point render the identical message for the
-    identical condition, and raisers chain `exc` so the original
-    backend traceback survives."""
-    devices, exc = probe_backend(timeout_s)
-    if devices is not None:
-        return devices, None, None
-    if exc is not None:
-        return None, f"{type(exc).__name__}: {exc}", exc
-    return None, (
-        f"jax backend did not initialize within {timeout_s:.0f}s "
-        "(device tunnel down?)"
-    ), None
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('ok')"],
+                capture_output=True, text=True, timeout=per_timeout_s,
+                cwd=cwd,
+            )
+            if proc.returncode == 0 and "ok" in proc.stdout:
+                return None
+            reason = (
+                proc.stderr.strip()[-1500:] or f"rc={proc.returncode}"
+            )
+            # A broken environment cannot heal by waiting; report it in
+            # seconds, not after the full retry schedule. Only the
+            # FINAL stderr line (the raising exception) counts —
+            # incidental import warnings earlier in the tail must not
+            # abort the blip-riding retries.
+            last_line = reason.splitlines()[-1] if reason else ""
+            if last_line.startswith(("ModuleNotFoundError", "ImportError")):
+                print(
+                    f"backend probe failed (unretryable): {last_line}",
+                    file=sys.stderr, flush=True,
+                )
+                return reason
+        except subprocess.TimeoutExpired:
+            reason = (
+                f"jax backend did not initialize within "
+                f"{per_timeout_s:.0f}s (device tunnel down?)"
+            )
+        print(
+            f"backend probe {attempt}/{attempts} failed: {reason}",
+            file=sys.stderr, flush=True,
+        )
+        if attempt < attempts:
+            # Pace fast failures to the attempt window: the point is to
+            # span the blip, not to burn every attempt in seconds.
+            elapsed = time.monotonic() - attempt_start
+            time.sleep(max(0.0, per_timeout_s - elapsed))
+    return reason

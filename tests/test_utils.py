@@ -54,57 +54,53 @@ def test_command_line_beats_env(monkeypatch):
     assert args.port == 7
 
 
-def test_probe_backend_returns_devices_when_backend_is_up():
-    """The watchdog's happy path: under the test conftest (CPU pinned)
-    the backend comes up immediately and the probe reports devices with
-    no error; the timeout/error paths are exercised by bench.py and
-    __graft_entry__ against a genuinely unreachable backend."""
-    from doorman_tpu.utils.backend import probe_backend
+def test_wait_for_backend_retries_and_reports(monkeypatch):
+    """The tunnel-blip waiter probes in throwaway subprocesses: it
+    returns None as soon as one probe succeeds and the last failure
+    reason when all attempts fail (loop logic only — a real spawn here
+    would race the shared device tunnel's actual state)."""
+    import subprocess
+    import types
 
-    devices, exc = probe_backend(timeout_s=60.0)
-    assert exc is None
-    assert devices  # the 8 virtual CPU devices
-
-
-def test_probe_backend_or_reason_happy_and_failure_messages():
-    """The shared diagnostic formatting the bench and entry point both
-    use: devices on success, a reason string naming the failure mode
-    otherwise."""
     from doorman_tpu.utils import backend
 
-    devices, reason, exc = backend.probe_backend_or_reason(timeout_s=60.0)
-    assert devices and reason is None and exc is None
+    calls = {"n": 0}
 
-    # Failure paths, via the underlying probe's two shapes.
-    orig = backend.probe_backend
-    try:
-        boom = ValueError("boom")
-        backend.probe_backend = lambda t: (None, boom)
-        _, reason, exc = backend.probe_backend_or_reason(5.0)
-        assert reason == "ValueError: boom" and exc is boom
-        backend.probe_backend = lambda t: (None, None)
-        _, reason, exc = backend.probe_backend_or_reason(5.0)
-        assert "did not initialize within 5s" in reason and exc is None
-    finally:
-        backend.probe_backend = orig
+    def fake_run(args, **kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return types.SimpleNamespace(
+                returncode=1, stdout="", stderr="boom"
+            )
+        return types.SimpleNamespace(returncode=0, stdout="ok\n", stderr="")
 
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert backend.wait_for_backend(attempts=5, per_timeout_s=0.05) is None
+    assert calls["n"] == 3
 
-def test_split_for_download_thresholds():
-    """Small or low-rank arrays pass through; big ones split into
-    leading-axis views that cover the array exactly."""
-    import numpy as np
+    calls["n"] = 0
 
-    from doorman_tpu.utils.transfer import split_for_download
+    def always_timeout(args, **kw):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(cmd=args, timeout=1.0)
 
-    small = np.zeros((8, 8), np.float32)
-    assert split_for_download(small) == [small]
-    assert len(split_for_download(np.float32(3.0))) == 1  # scalar path
+    monkeypatch.setattr(subprocess, "run", always_timeout)
+    reason = backend.wait_for_backend(attempts=2, per_timeout_s=0.05)
+    assert reason is not None and "did not initialize" in reason
+    assert calls["n"] == 2
 
-    big = np.arange(2 * (1 << 17), dtype=np.float32).reshape(-1, 64)
-    parts = split_for_download(big)
-    assert len(parts) == 4  # ~256 KB per stream at 1 MB
-    np.testing.assert_array_equal(np.concatenate(parts), big)
+    # Unretryable environment breakage (no jax) reports immediately
+    # instead of pacing through the whole retry schedule.
+    calls["n"] = 0
 
-    from doorman_tpu.utils.transfer import land_parts
+    def broken_env(args, **kw):
+        calls["n"] += 1
+        return types.SimpleNamespace(
+            returncode=1, stdout="",
+            stderr="ModuleNotFoundError: No module named 'jax'",
+        )
 
-    np.testing.assert_array_equal(land_parts(parts), big)
+    monkeypatch.setattr(subprocess, "run", broken_env)
+    reason = backend.wait_for_backend(attempts=5, per_timeout_s=0.05)
+    assert "ModuleNotFoundError" in reason
+    assert calls["n"] == 1

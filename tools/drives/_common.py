@@ -84,30 +84,16 @@ def load_1m(server, seed: int = 1):
     return rids, cids
 
 
-def require_backend(timeout_s: float = 180.0) -> None:
+def require_backend() -> None:
     """Fail fast (exit 2) when the device backend cannot come up —
-    the tunneled TPU goes down periodically, and a drive hanging at
-    its first device op tells the operator nothing. The probe runs in
-    a THROWAWAY subprocess: TPU runtimes grant one process exclusive
-    device access, so probing in this (parent) process would hold the
-    chip and starve the servers the drives spawn. Call BEFORE spawning
+    worst case ~2x120s of paced probing, riding out a short tunnel
+    blip. Probes run in throwaway subprocesses (TPU runtimes grant one
+    process exclusive device access; probing in this parent would
+    starve the servers the drives spawn). Call BEFORE spawning
     anything, so a backend-down exit leaks no children."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        print(
-            f"DEVICE BACKEND UNAVAILABLE: no backend init within "
-            f"{timeout_s:.0f}s (device tunnel down?)",
-            file=sys.stderr,
-        )
-        raise SystemExit(2)
-    if proc.returncode != 0 or "ok" not in proc.stdout:
-        print(
-            "DEVICE BACKEND UNAVAILABLE: "
-            + (proc.stderr.strip()[-500:] or f"rc={proc.returncode}"),
-            file=sys.stderr,
-        )
+    from doorman_tpu.utils.backend import wait_for_backend
+
+    reason = wait_for_backend(attempts=2, per_timeout_s=120.0, cwd=REPO)
+    if reason is not None:
+        print(f"DEVICE BACKEND UNAVAILABLE: {reason}", file=sys.stderr)
         raise SystemExit(2)
