@@ -81,6 +81,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="dial the parent with TLS (system roots)")
     p.add_argument("--parent-tls-ca", default="",
                    help="PEM root certificate for the parent (implies TLS)")
+    p.add_argument("--jax-platform", default="",
+                   help="pin the JAX backend platform (e.g. 'cpu' to run "
+                        "the batched solve without an accelerator; some "
+                        "plugin platforms ignore the JAX_PLATFORMS env "
+                        "var, so this sets the config knob before first "
+                        "backend use)")
     p.add_argument("--log-level", default="info",
                    help="debug/info/warning/error")
     return p
@@ -191,6 +197,10 @@ def main(argv=None) -> None:
     parser = make_parser()
     flagenv.populate(parser)
     args = parser.parse_args(argv)
+    if args.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",

@@ -15,6 +15,28 @@ sys.path.insert(0, REPO)
 
 NUM_RES, PER_RES = 10_000, 100
 
+# DOORMAN_DRIVE_PLATFORM=cpu runs every drive against the CPU backend
+# (no device tunnel needed): spawned servers get --jax-platform, the
+# in-process drives pin jax themselves, and the backend probe is
+# skipped (nothing to wait for).
+PLATFORM = os.environ.get("DOORMAN_DRIVE_PLATFORM", "")
+
+
+def platform_args() -> list:
+    """Extra server CLI args pinning the backend when the drive runs
+    on an explicit platform."""
+    return ["--jax-platform", PLATFORM] if PLATFORM else []
+
+
+def pin_platform_in_process() -> None:
+    """For drives that run the solver in THIS process."""
+    if PLATFORM:
+        import jax
+
+        jax.config.update("jax_platforms", PLATFORM)
+        if PLATFORM == "cpu":
+            jax.config.update("jax_enable_x64", True)
+
 
 def spawn(args, name="proc"):
     """Start a child with stdout+stderr appended to a temp log file
@@ -91,6 +113,9 @@ def require_backend() -> None:
     process exclusive device access; probing in this parent would
     starve the servers the drives spawn). Call BEFORE spawning
     anything, so a backend-down exit leaks no children."""
+    if PLATFORM == "cpu":
+        return  # host backend: nothing to wait for (any other explicit
+        # platform still needs the device, so the probe still gates)
     from doorman_tpu.utils.backend import wait_for_backend
 
     reason = wait_for_backend(attempts=2, per_timeout_s=120.0, cwd=REPO)

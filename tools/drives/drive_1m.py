@@ -11,7 +11,7 @@ import time
 
 import numpy as np
 
-from _common import require_backend, NUM_RES, load_1m
+from _common import pin_platform_in_process, require_backend, NUM_RES, load_1m
 
 
 async def main():
@@ -105,4 +105,5 @@ resources:
 
 
 require_backend()
+pin_platform_in_process()
 asyncio.run(main())

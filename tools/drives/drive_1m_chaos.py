@@ -6,7 +6,7 @@ import asyncio
 import sys
 import time
 
-from _common import require_backend, load_1m
+from _common import pin_platform_in_process, require_backend, load_1m
 
 CFG = """
 resources:
@@ -97,4 +97,5 @@ async def main():
 
 
 require_backend()
+pin_platform_in_process()
 asyncio.run(main())

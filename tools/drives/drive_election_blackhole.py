@@ -9,7 +9,7 @@ import subprocess
 import sys
 import time
 
-from _common import require_backend, REPO, spawn, stop, tail, write_config
+from _common import platform_args, require_backend, REPO, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
 
@@ -41,7 +41,7 @@ proc = spawn(
      "--etcd-endpoints", f"{bh_addr},{fake.address}",
      "--master-election-lock", "/doorman/master",
      "--master-delay", "6.0",
-     "--server-id", f"127.0.0.1:{port}"],
+     "--server-id", f"127.0.0.1:{port}"] + platform_args(),
     name="blackhole-server",
 )
 try:
@@ -59,9 +59,9 @@ try:
 
     out = subprocess.run(
         [sys.executable, "-m", "doorman_tpu.cmd.client",
-         "--server", f"127.0.0.1:{port}", "--timeout", "20",
+         "--server", f"127.0.0.1:{port}", "--timeout", "45",
          "res0", "10"],
-        cwd=REPO, capture_output=True, text=True, timeout=60,
+        cwd=REPO, capture_output=True, text=True, timeout=120,
     )
     print("client stdout:", out.stdout.strip())
     print("client rc:", out.returncode)

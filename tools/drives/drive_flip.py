@@ -7,7 +7,7 @@ import subprocess
 import sys
 import time
 
-from _common import require_backend, REPO, spawn, stop, tail, write_config
+from _common import platform_args, require_backend, REPO, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
 
@@ -35,7 +35,7 @@ proc = spawn(
      "--etcd-endpoints", fake.address,
      "--master-election-lock", "/doorman/master",
      "--master-delay", "3.0",
-     "--server-id", f"127.0.0.1:{port}"],
+     "--server-id", f"127.0.0.1:{port}"] + platform_args(),
     name="flip-server",
 )
 
@@ -44,8 +44,8 @@ def one_shot(cid, wants):
     return subprocess.run(
         [sys.executable, "-m", "doorman_tpu.cmd.client",
          "--server", f"127.0.0.1:{port}", "--client-id", cid,
-         "--timeout", "20", "res0", str(wants)],
-        cwd=REPO, capture_output=True, text=True, timeout=60,
+         "--timeout", "45", "res0", str(wants)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
     )
 
 
@@ -55,7 +55,7 @@ try:
         assert proc.poll() is None, tail(proc)
         time.sleep(0.3)
     assert fake.value("/doorman/master"), "server never won mastership"
-    time.sleep(1.5)  # a few ticks
+    time.sleep(3.0)  # a few ticks (CPU mode compiles here)
 
     out = one_shot("pre", 10)
     assert out.returncode == 0 and "got 10" in out.stdout, (
@@ -77,7 +77,7 @@ try:
             break
         time.sleep(0.2)
     assert rewon, "server did not re-acquire mastership after the flip"
-    time.sleep(1.5)  # ticks on the fresh engine
+    time.sleep(3.0)  # ticks on the fresh engine
 
     out = one_shot("post", 7)
     assert out.returncode == 0 and "got 7" in out.stdout, (

@@ -6,7 +6,7 @@ import sys
 import time
 import urllib.request
 
-from _common import require_backend, spawn as _spawn, stop, tail, write_config
+from _common import platform_args, require_backend, spawn as _spawn, stop, tail, write_config
 
 require_backend()
 
@@ -45,7 +45,7 @@ try:
                     "--mode", "batch", "--native-store",
                     "--tick-interval", "0.5",
                     "--config", f"file:{cfg}",
-                    "--server-id", "127.0.0.1:16060"])
+                    "--server-id", "127.0.0.1:16060"] + platform_args())
     time.sleep(25)  # server compile warm-up happens on first ticks
     for w in range(3):
         spawn([sys.executable, "-m", "doorman_tpu.loadtest.worker",

@@ -5,7 +5,7 @@ import os
 import sys
 import time
 
-from _common import require_backend, spawn, stop, tail, write_config
+from _common import platform_args, require_backend, spawn, stop, tail, write_config
 
 require_backend()
 
@@ -37,7 +37,7 @@ proc = spawn(
      "--port", str(port), "--debug-port", "-1",
      "--mode", "batch", "--native-store", "--tick-interval", "0.4",
      "--config", f"file:{cfg}",
-     "--server-id", f"127.0.0.1:{port}"],
+     "--server-id", f"127.0.0.1:{port}"] + platform_args(),
     name="priority-server",
 )
 

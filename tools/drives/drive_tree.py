@@ -10,7 +10,7 @@ import sys
 import time
 import urllib.request
 
-from _common import require_backend, spawn, stop, tail, write_config
+from _common import platform_args, require_backend, spawn, stop, tail, write_config
 
 require_backend()
 
@@ -38,7 +38,7 @@ root = spawn(
      "--port", str(ROOT), "--debug-port", str(ROOT_DEBUG),
      "--mode", "batch", "--native-store", "--tick-interval", "0.4",
      "--config", f"file:{cfg}",
-     "--server-id", f"127.0.0.1:{ROOT}"],
+     "--server-id", f"127.0.0.1:{ROOT}"] + platform_args(),
     name="tree-root",
 )
 inter = spawn(
@@ -47,7 +47,7 @@ inter = spawn(
      "--mode", "batch", "--native-store", "--tick-interval", "0.4",
      "--parent", f"127.0.0.1:{ROOT}",
      "--minimum-refresh-interval", "1.0",
-     "--server-id", f"127.0.0.1:{INTER}"],
+     "--server-id", f"127.0.0.1:{INTER}"] + platform_args(),
     name="tree-inter",
 )
 

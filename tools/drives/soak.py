@@ -10,7 +10,7 @@ import os
 import sys
 import time
 
-from _common import require_backend, spawn, stop, tail, write_config
+from _common import platform_args, require_backend, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
 
@@ -40,7 +40,7 @@ server = spawn(
      "--config", f"file:{cfg}",
      "--etcd-endpoints", fake.address,
      "--master-election-lock", "/lock", "--master-delay", "5.0",
-     "--server-id", f"127.0.0.1:{port}"],
+     "--server-id", f"127.0.0.1:{port}"] + platform_args(),
     name="soak-server",
 )
 
