@@ -1,5 +1,6 @@
-"""CLI: python -m doorman_tpu.sim <scenario> [--run-for S] [--seed N]
-[--csv]"""
+"""CLI: python -m doorman_tpu.sim <scenario|all> [--run-for S] [--seed N]
+[--csv]. `all` runs scenarios 1-7 sequentially (one JSON summary line
+each), the counterpart of the reference's run_all_scenarios.sh."""
 
 from __future__ import annotations
 
@@ -10,7 +11,7 @@ import logging
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="doorman-tpu simulation")
-    parser.add_argument("scenario", choices=list("1234567"))
+    parser.add_argument("scenario", choices=list("1234567") + ["all"])
     parser.add_argument("--run-for", type=float, default=None)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--csv", action="store_true", help="write CSV report")
@@ -24,13 +25,15 @@ def main() -> None:
 
     from doorman_tpu.sim.scenarios import run_scenario
 
-    sim, reporter = run_scenario(
-        args.scenario, args.run_for, args.seed, write_csv=args.csv
-    )
-    summary = reporter.summary()
-    summary["scenario"] = args.scenario
-    summary["simulated_seconds"] = sim.clock.get_time()
-    print(json.dumps(summary))
+    scenarios = list("1234567") if args.scenario == "all" else [args.scenario]
+    for scenario in scenarios:
+        sim, reporter = run_scenario(
+            scenario, args.run_for, args.seed, write_csv=args.csv
+        )
+        summary = reporter.summary()
+        summary["scenario"] = scenario
+        summary["simulated_seconds"] = sim.clock.get_time()
+        print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

@@ -142,3 +142,22 @@ class TestScenarios:
         _, r1 = run_scenario("1", run_for=120, seed=7)
         _, r2 = run_scenario("1", run_for=120, seed=7)
         assert r1.summary() == r2.summary()
+
+
+def test_cli_all_runs_every_scenario():
+    """`python -m doorman_tpu.sim all` is the counterpart of the
+    reference's run_all_scenarios.sh: one JSON summary line per
+    scenario, all seven of them."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "doorman_tpu.sim", "all", "--run-for", "30"],
+        capture_output=True, text=True, timeout=300,
+        cwd=pathlib.Path(__file__).resolve().parent.parent,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert [json.loads(l)["scenario"] for l in lines] == list("1234567")
