@@ -205,6 +205,8 @@ def main(argv=None) -> None:
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
+    if args.jax_platform:
+        log.info("jax platform pinned to %r", args.jax_platform)
     try:
         asyncio.run(serve(args))
     except KeyboardInterrupt:

@@ -140,6 +140,7 @@ class DebugServer:
                 f"{html.escape(st['current_master'] or '(unknown)')}<br>"
                 f"election: {html.escape(st['election'])}<br>"
                 f"mode: {html.escape(st['mode'])} | "
+                f"backend: {html.escape(st.get('backend') or '(no tick yet)')} | "
                 f"ticks: {st.get('ticks', 0)} "
                 f"(idle: {st.get('idle_ticks', 0)})</p>"
                 + (

@@ -925,6 +925,11 @@ class CapacityServer(CapacityServicer):
             "election": str(self.election),
             "current_master": self.current_master,
             "mode": self.mode,
+            # The platform actually solving (only read once a tick has
+            # completed: jax.default_backend() would otherwise TRIGGER
+            # backend init from the status page, hanging the debug
+            # thread when the device tunnel is down).
+            "backend": self._backend_platform(),
             "ticks": self._ticks_done,
             # Ticks the resident solver served without device work (the
             # idle fast path); a busy server shows 0 here.
@@ -950,6 +955,18 @@ class CapacityServer(CapacityServicer):
                 else ""
             ),
         }
+
+    def _backend_platform(self) -> str:
+        if self._ticks_done <= 0:
+            return ""
+        try:
+            import jax
+
+            return jax.default_backend()
+        except Exception:
+            # Distinct from the pre-first-tick "" sentinel: ticks ran,
+            # so "(no tick yet)" would hide a real backend error.
+            return "(error)"
 
     def resource_lease_status(self, resource_id: str):
         res = self.resources.get(resource_id)
