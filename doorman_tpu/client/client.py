@@ -134,19 +134,32 @@ class Client:
         self._wake.set()
         return res
 
+    # Releases are best-effort with a bound: leases self-expire (the
+    # reference's core design), so a release against a masterless or
+    # wedged server must not hang the caller — the connection's
+    # default retry-forever loop would otherwise pin close() (and the
+    # one-shot CLI) indefinitely.
+    RELEASE_TIMEOUT = 10.0
+
     async def release_resource(self, res: ClientResource) -> None:
         if self.resources.pop(res.id, None) is None:
             return
         try:
-            await self.conn.execute(
-                lambda stub: stub.ReleaseCapacity(
-                    pb.ReleaseCapacityRequest(
-                        client_id=self.id, resource_id=[res.id]
+            await asyncio.wait_for(
+                self.conn.execute(
+                    lambda stub: stub.ReleaseCapacity(
+                        pb.ReleaseCapacityRequest(
+                            client_id=self.id, resource_id=[res.id]
+                        )
                     )
-                )
+                ),
+                self.RELEASE_TIMEOUT,
             )
-        except Exception:
-            log.exception("%s: ReleaseCapacity failed", self.id)
+        except Exception as e:
+            log.warning(
+                "%s: ReleaseCapacity failed (%r); leases will expire "
+                "on their own", self.id, e,
+            )
 
     async def close(self) -> None:
         self._closed = True
@@ -158,16 +171,22 @@ class Client:
                 pass
         if self.resources:
             try:
-                await self.conn.execute(
-                    lambda stub: stub.ReleaseCapacity(
-                        pb.ReleaseCapacityRequest(
-                            client_id=self.id,
-                            resource_id=list(self.resources),
+                await asyncio.wait_for(
+                    self.conn.execute(
+                        lambda stub: stub.ReleaseCapacity(
+                            pb.ReleaseCapacityRequest(
+                                client_id=self.id,
+                                resource_id=list(self.resources),
+                            )
                         )
-                    )
+                    ),
+                    self.RELEASE_TIMEOUT,
                 )
-            except Exception:
-                log.exception("%s: ReleaseCapacity on close failed", self.id)
+            except Exception as e:
+                log.warning(
+                    "%s: ReleaseCapacity on close failed (%r); leases "
+                    "will expire on their own", self.id, e,
+                )
         await self.conn.close()
 
     # ------------------------------------------------------------------
