@@ -40,6 +40,39 @@ import time
 
 import numpy as np
 
+# Every JSON line printed also lands in doc/bench_last.json (with a
+# timestamp and platform) via emit(): a committed, auditable record of
+# the last successful measurement that survives driver-window tunnel
+# outages (round-4 lesson: the measured numbers lived only in prose
+# while BENCH_r04 recorded backend_unreachable).
+_EMITTED: list = []
+
+
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+    _EMITTED.append(obj)
+
+
+def write_artifact() -> None:
+    import os
+    import platform
+
+    import jax
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "doc", "bench_last.json"
+    )
+    record = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "host": platform.node(),
+        "results": _EMITTED,
+    }
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+
 NUM_CLIENTS = 1_000_000
 NUM_RESOURCES = 10_000
 CLIENTS_PER_RESOURCE = NUM_CLIENTS // NUM_RESOURCES  # 100
@@ -200,22 +233,18 @@ def main() -> None:
     # rule explicit and median/mean alongside for run-over-run
     # comparability.
     ms = per_tick_ms[0]
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "lease_recompute_1m_clients_x_10k_resources_wall_ms"
-                ),
-                "value": round(ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(TARGET_MS / ms, 3),
-                "selection": f"best_of_{RUNS}",
-                "median_ms": round(
-                    float(np.median(per_tick_ms)), 3
-                ),
-                "mean_ms": round(float(np.mean(per_tick_ms)), 3),
-            }
-        )
+    emit(
+        {
+            "metric": (
+                "lease_recompute_1m_clients_x_10k_resources_wall_ms"
+            ),
+            "value": round(ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(TARGET_MS / ms, 3),
+            "selection": f"best_of_{RUNS}",
+            "median_ms": round(float(np.median(per_tick_ms)), 3),
+            "mean_ms": round(float(np.mean(per_tick_ms)), 3),
+        }
     )
 
 
@@ -405,22 +434,158 @@ def bench_server_tick() -> None:
     phases["churn"] = round(
         float(np.mean(churn_ms[SERVER_WARMUP:])), 3
     )
-    print(
-        json.dumps(
+    emit(
+        {
+            "metric": "server_tick_1m_leases_native_store_wall_ms",
+            "value": round(med, 3),
+            "unit": "ms",
+            "vs_baseline": round(SERVER_TICK_TARGET_MS / med, 3),
+            "selection": f"median_of_{TICKS_SERVER}",
+            "best_ms": round(timed[0], 3),
+            "p50_ms": round(float(np.percentile(timed, 50)), 3),
+            "p90_ms": round(float(np.percentile(timed, 90)), 3),
+            "p99_ms": round(float(np.percentile(timed, 99)), 3),
+            "pipeline_depth": PIPELINE_DEPTH_SERVER,
+            "rotate_ticks": SERVER_ROTATE_TICKS,
+            "phase_ms": phases,
+        }
+    )
+
+
+def bench_server_tick_wide() -> None:
+    """Third metric: the WIDE-resource server tick — doorman's headline
+    shape, ONE shared resource with a huge client population
+    (/root/reference/doc/design.md:218; the reference's O(n)-per-request
+    loop is /root/reference/go/server/doorman/algorithm.go:213-292) —
+    measured end-to-end through the chunked wide resident solver
+    (solver/resident_wide.py) with the native engine as the store of
+    record, at 1 resource x 1M clients and 10 x 100k.
+
+    Per tick: 5% of clients change wants (slot-granular dirty tracking
+    ships only those slots), the full table solves on device with the
+    two-level chunk reduction, and the rotation slice + full-dirty rows
+    download and apply. Same pipelining/warmup discipline as
+    bench_server_tick; median reported with p50/p90/p99."""
+    import jax
+
+    from doorman_tpu import native
+    from doorman_tpu.core.resource import Resource
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.solver.resident_wide import WideResidentSolver
+
+    device = jax.devices()[0]
+    if device.platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
+
+    from doorman_tpu.algorithms.tick import oracle_row
+
+    for label, R, C in (("1res_1m", 1, 1_000_000),
+                        ("10res_100k", 10, 100_000)):
+        rng = np.random.default_rng(23)
+        engine = native.StoreEngine()
+        capacity = float(C) * 40.0  # oversubscribed (mean wants ~50)
+        resources = []
+        rids = np.empty(R * C, np.int32)
+        for r in range(R):
+            tpl = pb.ResourceTemplate(
+                identifier_glob=f"wide{r}",
+                capacity=capacity,
+                algorithm=pb.Algorithm(
+                    kind=pb.Algorithm.PROPORTIONAL_SHARE,
+                    lease_length=600, refresh_interval=16,
+                ),
+            )
+            res = Resource(f"wide{r}", tpl, store_factory=engine.store)
+            resources.append(res)
+            rids[r * C : (r + 1) * C] = res.store._rid
+        cids = np.array(
+            [engine.client_handle(f"w{i}") for i in range(R * C)],
+            np.int64,
+        )
+        wants = rng.integers(1, 100, R * C).astype(np.float64)
+        now = time.time()
+        engine.bulk_assign(
+            rids, cids, np.full(R * C, now + 600.0),
+            np.full(R * C, 16.0), np.zeros(R * C), wants,
+            np.ones(R * C, np.int32),
+        )
+
+        solver = WideResidentSolver(
+            engine, dtype=dtype, device=device,
+            rotate_ticks=1,  # first tick delivers everything
+        )
+        solver.step(resources)  # build + compile + full delivery
+
+        # Oracle spot-check of the first tick (PROPORTIONAL_SHARE over
+        # the full population, has=0 snapshot).
+        for r in range(R):
+            w = wants[r * C : (r + 1) * C]
+            expected = oracle_row(
+                int(pb.Algorithm.PROPORTIONAL_SHARE), capacity, 0.0,
+                w.astype(np.float64), np.zeros(C), np.ones(C),
+            )
+            sample = rng.integers(0, C, 20)
+            got = np.array(
+                [resources[r].store.get(f"w{r * C + i}").has
+                 for i in sample]
+            )
+            np.testing.assert_allclose(
+                got, expected[sample], rtol=2e-6, atol=1e-4,
+                err_msg=f"{label} resource {r}",
+            )
+
+        solver.rotate_ticks = SERVER_ROTATE_TICKS
+        n_churn = (R * C) // 20  # 5% of clients per tick
+        n_ticks = SERVER_WARMUP + TICKS_WIDE
+        churn_edges = [
+            rng.choice(R * C, n_churn, replace=False)
+            for _ in range(n_ticks)
+        ]
+        churn_wants = [
+            rng.integers(1, 100, n_churn).astype(np.float64)
+            for _ in range(n_ticks)
+        ]
+
+        tick_ms = []
+        handles = []
+        for t in range(n_ticks):
+            t0 = time.perf_counter()
+            edge = churn_edges[t]
+            engine.bulk_refresh(
+                rids[edge], cids[edge],
+                np.full(n_churn, time.time() + 600.0),
+                np.full(n_churn, 16.0), churn_wants[t],
+            )
+            handles.append(solver.dispatch(resources))
+            if len(handles) >= PIPELINE_DEPTH_SERVER:
+                solver.collect(handles.pop(0))
+            tick_ms.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        for h in handles:
+            solver.collect(h)
+        drain_ms = (time.perf_counter() - t0) * 1000.0
+        timed = sorted(
+            t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
+        )
+        med = float(np.median(timed))
+        emit(
             {
-                "metric": "server_tick_1m_leases_native_store_wall_ms",
+                "metric": f"server_tick_wide_{label}_wall_ms",
                 "value": round(med, 3),
                 "unit": "ms",
                 "vs_baseline": round(SERVER_TICK_TARGET_MS / med, 3),
-                "selection": f"median_of_{TICKS_SERVER}",
+                "selection": f"median_of_{TICKS_WIDE}",
                 "best_ms": round(timed[0], 3),
+                "p50_ms": round(float(np.percentile(timed, 50)), 3),
                 "p90_ms": round(float(np.percentile(timed, 90)), 3),
-                "pipeline_depth": PIPELINE_DEPTH_SERVER,
+                "p99_ms": round(float(np.percentile(timed, 99)), 3),
+                "chunk_rows": solver._R,
                 "rotate_ticks": SERVER_ROTATE_TICKS,
-                "phase_ms": phases,
             }
         )
-    )
 
 
 def gate_pallas_kernels() -> None:
@@ -440,15 +605,13 @@ def gate_pallas_kernels() -> None:
 
     device = jax.devices()[0]
     if device.platform != "tpu":
-        print(
-            json.dumps(
-                {
-                    "metric": "pallas_tpu_gate",
-                    "value": 0,
-                    "unit": "skipped",
-                    "note": f"platform {device.platform} is not tpu",
-                }
-            )
+        emit(
+            {
+                "metric": "pallas_tpu_gate",
+                "value": 0,
+                "unit": "skipped",
+                "note": f"platform {device.platform} is not tpu",
+            }
         )
         return
 
@@ -524,17 +687,15 @@ def gate_pallas_kernels() -> None:
             f"pallas_priority on-chip divergence {prio_err:.3g} vs the "
             f"XLA solve exceeds {bound:g}"
         )
-    print(
-        json.dumps(
-            {
-                "metric": "pallas_tpu_gate",
-                "value": 1,
-                "unit": "ok",
-                "dense_rel_err": float(f"{dense_err:.3g}"),
-                "priority_rel_err": float(f"{prio_err:.3g}"),
-                "bound": bound,
-            }
-        )
+    emit(
+        {
+            "metric": "pallas_tpu_gate",
+            "value": 1,
+            "unit": "ok",
+            "dense_rel_err": float(f"{dense_err:.3g}"),
+            "priority_rel_err": float(f"{prio_err:.3g}"),
+            "bound": bound,
+        }
     )
 
 
@@ -551,7 +712,10 @@ SERVER_TICK_TARGET_MS = 100.0
 SERVER_ROTATE_TICKS = 16  # grant delivery rides the 16s refresh cadence
 PIPELINE_DEPTH_SERVER = 4
 SERVER_WARMUP = 6
-TICKS_SERVER = 24
+# >= 100 measured ticks so the reported p90/p99 mean something (the
+# round-4 verdict asked for percentiles over a long window on record).
+TICKS_SERVER = 100
+TICKS_WIDE = 40
 
 
 def _require_backend() -> None:
@@ -592,4 +756,8 @@ if __name__ == "__main__":
     _require_backend()
     gate_pallas_kernels()
     main()
+    bench_server_tick_wide()
+    # The narrow server tick stays LAST: the driver parses the final
+    # JSON line as the round's headline metric.
     bench_server_tick()
+    write_artifact()

@@ -61,23 +61,17 @@ struct ResourceStore {
   // scan recomputes it exactly from the survivors.
   double min_expiry = std::numeric_limits<double>::infinity();
 
-  void remove_slot(size_t slot) {
-    const Lease &l = leases[slot];
-    sum_has -= l.has;
-    sum_wants -= l.wants;
-    count -= l.subclients;
-    index.erase(clients[slot]);
-    const size_t last = clients.size() - 1;
-    if (slot != last) {
-      clients[slot] = clients[last];
-      leases[slot] = leases[last];
-      index[clients[slot]] = slot;
-    }
-    clients.pop_back();
-    leases.pop_back();
-    ++version;
-    dirty_full = 1;
-  }
+  // Wide-resource (chunked) tracking: a resource wider than the dense
+  // bucket cap is split across consecutive device rows of
+  // engine.chunk_width slots each. Dirtiness is per SLOT (a single
+  // client's wants change must not re-upload a million-lease table),
+  // write-back validity per CHUNK (chunk_version bumps whenever the
+  // slot<->client mapping inside that chunk changes, so an in-flight
+  // apply skips exactly the chunks whose slot order went stale).
+  bool chunk_tracked = false;
+  std::vector<uint64_t> chunk_version;
+  std::vector<uint8_t> slot_dirty;        // 0 clean, 1 wants-only, 2 full
+  std::vector<int64_t> slot_dirty_list;
 };
 
 struct Engine {
@@ -92,6 +86,14 @@ struct Engine {
   // storm must not defeat delta uploads.
   std::vector<uint8_t> dirty_flags;
   std::vector<int32_t> dirty_list;
+  // Chunk width for wide-resource tracking (0 = disabled). Resources
+  // opted in via dm_chunk_config get slot-granular dirty lists and
+  // per-chunk membership versions on top of the per-resource flags
+  // above; the two channels are independent, so the narrow resident
+  // solver's drains never consume (or get consumed by) the wide
+  // solver's.
+  int64_t chunk_width = 0;
+  std::vector<int32_t> slot_dirty_rids;  // tracked rids with dirty slots
   // One writer (tick thread) and many RPC-handler calls share the
   // engine once the server moves prepare/apply off the event loop;
   // every exported call locks. ctypes releases the GIL during calls,
@@ -99,16 +101,69 @@ struct Engine {
   std::mutex mu;
 };
 
+// Mark one slot of a chunk-tracked resource dirty (level 1 = wants-only,
+// 2 = full: has/subclients/priority or slot content changed). Levels
+// only upgrade until the next drain.
+inline void mark_slot(Engine *e, int32_t rid, ResourceStore &r, size_t slot,
+                      uint8_t level) {
+  if (!r.chunk_tracked || e->chunk_width <= 0) return;
+  if (r.slot_dirty.size() <= slot) r.slot_dirty.resize(slot + 1, 0);
+  if (!r.slot_dirty[slot]) {
+    if (r.slot_dirty_list.empty()) e->slot_dirty_rids.push_back(rid);
+    r.slot_dirty_list.push_back(static_cast<int64_t>(slot));
+  }
+  if (level > r.slot_dirty[slot]) r.slot_dirty[slot] = level;
+}
+
+// The slot<->client mapping inside `slot`'s chunk changed (insert,
+// swap-remove): an in-flight dense apply of that chunk would write
+// grants against the wrong clients, so its version moves.
+inline void bump_chunk(Engine *e, ResourceStore &r, size_t slot) {
+  if (!r.chunk_tracked || e->chunk_width <= 0) return;
+  const size_t c = slot / static_cast<size_t>(e->chunk_width);
+  if (r.chunk_version.size() <= c) r.chunk_version.resize(c + 1, 0);
+  ++r.chunk_version[c];
+}
+
+// Swap-remove `slot`, maintaining aggregates, the membership epoch, and
+// — for chunk-tracked resources — the chunk versions and slot dirt of
+// both touched chunks (the removed slot and the moved-from last slot).
+inline void remove_slot(Engine *e, int32_t rid, ResourceStore &r,
+                        size_t slot) {
+  const Lease &l = r.leases[slot];
+  r.sum_has -= l.has;
+  r.sum_wants -= l.wants;
+  r.count -= l.subclients;
+  r.index.erase(r.clients[slot]);
+  const size_t last = r.clients.size() - 1;
+  if (slot != last) {
+    r.clients[slot] = r.clients[last];
+    r.leases[slot] = r.leases[last];
+    r.index[r.clients[slot]] = slot;
+    mark_slot(e, rid, r, slot, 2);
+  }
+  r.clients.pop_back();
+  r.leases.pop_back();
+  ++r.version;
+  r.dirty_full = 1;
+  bump_chunk(e, r, slot);
+  bump_chunk(e, r, last);
+  // The vacated last slot goes inactive on device; ship its (zeroed)
+  // state so a stale lease doesn't keep solving there.
+  mark_slot(e, rid, r, last, 2);
+}
+
 // Shared expiry sweep: skipped entirely while nothing can be expired
 // (the min_expiry lower bound), else swap-removes lapsed leases and
 // recomputes the exact bound from the survivors.
-inline int64_t sweep_resource(ResourceStore &r, double now) {
+inline int64_t sweep_resource(Engine *e, int32_t rid, ResourceStore &r,
+                              double now) {
   if (!(now > r.min_expiry)) return 0;
   int64_t removed = 0;
   double new_min = std::numeric_limits<double>::infinity();
   for (size_t slot = 0; slot < r.leases.size();) {
     if (now > r.leases[slot].expiry) {
-      r.remove_slot(slot);  // swap-remove: re-check the same slot
+      remove_slot(e, rid, r, slot);  // swap-remove: re-check the slot
       ++removed;
     } else {
       if (r.leases[slot].expiry < new_min) new_min = r.leases[slot].expiry;
@@ -144,7 +199,8 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
   ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) {
-    r.index.emplace(cid, r.clients.size());
+    const size_t slot = r.clients.size();
+    r.index.emplace(cid, slot);
     r.clients.push_back(cid);
     r.leases.push_back(fresh);
     r.sum_has += fresh.has;
@@ -153,6 +209,8 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
     ++r.version;
     r.dirty_full = 1;
     mark_dirty(e, rid);
+    bump_chunk(e, r, slot);
+    mark_slot(e, rid, r, slot, 2);
     if (fresh.expiry < r.min_expiry) r.min_expiry = fresh.expiry;
     return 0;
   }
@@ -163,6 +221,7 @@ inline int32_t upsert(Engine *e, int32_t rid, int64_t cid,
   if (full_changed) r.dirty_full = 1;
   if (full_changed || l.wants != fresh.wants) {
     mark_dirty(e, rid);
+    mark_slot(e, rid, r, it->second, full_changed ? 2 : 1);
   }
   r.sum_has += fresh.has - l.has;
   r.sum_wants += fresh.wants - l.wants;
@@ -260,7 +319,7 @@ int32_t dm_release(Engine *e, int32_t rid, int64_t cid) {
   ResourceStore &r = e->resources[rid];
   auto it = r.index.find(cid);
   if (it == r.index.end()) return 0;
-  r.remove_slot(it->second);
+  remove_slot(e, rid, r, it->second);
   mark_dirty(e, rid);
   return 1;
 }
@@ -271,7 +330,7 @@ int64_t dm_clean(Engine *e, int32_t rid, double now) {
   std::lock_guard<std::mutex> lock(e->mu);
   if (!valid_rid(e, rid)) return 0;
   ResourceStore &r = e->resources[rid];
-  const int64_t removed = sweep_resource(r, now);
+  const int64_t removed = sweep_resource(e, rid, r, now);
   if (removed) mark_dirty(e, rid);
   return removed;
 }
@@ -282,7 +341,8 @@ int64_t dm_clean_all(Engine *e, double now) {
   int64_t removed = 0;
   for (size_t rid = 0; rid < e->resources.size(); ++rid) {
     ResourceStore &r = e->resources[rid];
-    const int64_t here = sweep_resource(r, now);
+    const int64_t here =
+        sweep_resource(e, static_cast<int32_t>(rid), r, now);
     if (here) mark_dirty(e, static_cast<int32_t>(rid));
     removed += here;
   }
@@ -398,7 +458,10 @@ int64_t dm_bulk_refresh(Engine *e, const int32_t *rid, const int64_t *cid,
     auto it = r.index.find(cid[i]);
     if (it == r.index.end()) continue;
     Lease &l = r.leases[it->second];
-    if (l.wants != wants[i]) mark_dirty(e, rid[i]);
+    if (l.wants != wants[i]) {
+      mark_dirty(e, rid[i]);
+      mark_slot(e, rid[i], r, it->second, 1);
+    }
     r.sum_wants += wants[i] - l.wants;
     l.wants = wants[i];
     l.expiry = expiry[i];
@@ -436,6 +499,195 @@ int64_t dm_apply_dense(Engine *e, const int32_t *rids, int64_t n,
           std::min<int64_t>(K, static_cast<int64_t>(r.leases.size()));
       for (int64_t j = 0; j < filled; ++j) {
         Lease &l = r.leases[j];
+        r.sum_has += g[j] - l.has;
+        l.has = g[j];
+      }
+    }
+    ++applied;
+  }
+  return applied;
+}
+
+// ---- Wide-resource (chunked) tracking --------------------------------
+//
+// A resource wider than the dense bucket cap spans consecutive device
+// rows of `W` slots each (slot s lives at row s/W, lane s%W). These
+// calls give the wide resident solver slot-granular upload deltas and
+// chunk-granular write-back validity, independent of the per-resource
+// dirty channel the narrow solver drains.
+
+// Install the tracked set: chunk width W, tracked rids. Clears all
+// previous chunk state (slot dirt, chunk versions) engine-wide; the
+// caller repacks every tracked chunk right after (a rebuild), so
+// versions restart at 0.
+void dm_chunk_config(Engine *e, const int32_t *rids, int64_t n,
+                     int64_t W) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  e->chunk_width = W;
+  e->slot_dirty_rids.clear();
+  for (ResourceStore &r : e->resources) {
+    r.chunk_tracked = false;
+    r.chunk_version.clear();
+    r.slot_dirty.clear();
+    r.slot_dirty_list.clear();
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    if (!valid_rid(e, rids[i])) continue;
+    ResourceStore &r = e->resources[rids[i]];
+    r.chunk_tracked = true;
+    const size_t chunks =
+        W > 0 ? (r.leases.size() + W - 1) / static_cast<size_t>(W) : 0;
+    r.chunk_version.assign(std::max<size_t>(chunks, 1), 0);
+  }
+}
+
+// Drain one tracked resource's dirty slots: writes up to `cap`
+// (slot, level) pairs — level 1 = wants-only, 2 = full — and clears
+// them. Returns the count written (call again if == cap).
+int64_t dm_drain_slots(Engine *e, int32_t rid, int64_t *slots_out,
+                       uint8_t *level_out, int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
+  ResourceStore &r = e->resources[rid];
+  const int64_t n = std::min<int64_t>(
+      cap, static_cast<int64_t>(r.slot_dirty_list.size()));
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t slot = r.slot_dirty_list[i];
+    slots_out[i] = slot;
+    level_out[i] =
+        slot < static_cast<int64_t>(r.slot_dirty.size())
+            ? r.slot_dirty[slot]
+            : uint8_t{2};
+    if (slot < static_cast<int64_t>(r.slot_dirty.size()))
+      r.slot_dirty[slot] = 0;
+  }
+  r.slot_dirty_list.erase(r.slot_dirty_list.begin(),
+                          r.slot_dirty_list.begin() + n);
+  if (r.slot_dirty_list.empty()) {
+    auto &v = e->slot_dirty_rids;
+    v.erase(std::remove(v.begin(), v.end(), rid), v.end());
+  }
+  return n;
+}
+
+// Tracked rids that currently have dirty slots; returns count (<= cap).
+int64_t dm_dirty_slot_rids(Engine *e, int32_t *out, int64_t cap) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  const int64_t n = std::min<int64_t>(
+      cap, static_cast<int64_t>(e->slot_dirty_rids.size()));
+  for (int64_t i = 0; i < n; ++i) out[i] = e->slot_dirty_rids[i];
+  return n;
+}
+
+// Gather n slots' solver-visible state (wants/has/subclients/active);
+// slots at/beyond the lease count read as inactive zeros (that IS the
+// upload that clears a vacated lane on device).
+void dm_pack_slots(Engine *e, int32_t rid, const int64_t *slots, int64_t n,
+                   double *wants, double *has, double *sub, uint8_t *act) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  const bool ok = valid_rid(e, rid);
+  const ResourceStore *r = ok ? &e->resources[rid] : nullptr;
+  const int64_t size =
+      ok ? static_cast<int64_t>(r->leases.size()) : 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t s = slots[i];
+    if (s < 0 || s >= size) {
+      wants[i] = has[i] = sub[i] = 0.0;
+      act[i] = 0;
+      continue;
+    }
+    const Lease &l = r->leases[s];
+    wants[i] = l.wants;
+    has[i] = l.has;
+    sub[i] = l.subclients;
+    act[i] = 1;
+  }
+}
+
+// Pack n chunks as rows of the [n, W] slabs: row i holds slots
+// [chunks[i]*W, chunks[i]*W + W) of rids[i] (zeros beyond the lease
+// count). filled_out[i] = live slots in the chunk; versions_out[i] =
+// the chunk's membership version at pack time.
+void dm_pack_chunks(Engine *e, const int32_t *rids, const int32_t *chunks,
+                    int64_t n, int64_t W, double *wants, double *has,
+                    double *sub, uint8_t *act, int32_t *filled_out,
+                    uint64_t *versions_out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    double *w = wants + i * W;
+    double *h = has + i * W;
+    double *s = sub + i * W;
+    uint8_t *a = act + i * W;
+    std::fill(w, w + W, 0.0);
+    std::fill(h, h + W, 0.0);
+    std::fill(s, s + W, 0.0);
+    std::fill(a, a + W, uint8_t{0});
+    filled_out[i] = 0;
+    versions_out[i] = 0;
+    if (!valid_rid(e, rids[i]) || chunks[i] < 0) continue;
+    const ResourceStore &r = e->resources[rids[i]];
+    const int64_t base = static_cast<int64_t>(chunks[i]) * W;
+    const int64_t size = static_cast<int64_t>(r.leases.size());
+    const int64_t filled = std::min<int64_t>(W, size - base);
+    for (int64_t j = 0; j < filled; ++j) {
+      const Lease &l = r.leases[base + j];
+      w[j] = l.wants;
+      h[j] = l.has;
+      s[j] = l.subclients;
+      a[j] = 1;
+    }
+    if (filled > 0) filled_out[i] = static_cast<int32_t>(filled);
+    if (chunks[i] < static_cast<int64_t>(r.chunk_version.size()))
+      versions_out[i] = r.chunk_version[chunks[i]];
+  }
+}
+
+// Read the current membership versions of n chunks. The wide solver
+// reads these AFTER draining slot dirt and BEFORE packing: any
+// membership change landing after the read bumps the version (so the
+// in-flight apply skips) and re-marks its slots (so the next tick
+// re-delivers) — expected versions can lag the device state but never
+// lead it, which makes a mismatch always the safe direction.
+void dm_chunk_versions(Engine *e, const int32_t *rids,
+                       const int32_t *chunks, int64_t n,
+                       uint64_t *versions_out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    versions_out[i] = 0;
+    if (!valid_rid(e, rids[i]) || chunks[i] < 0) continue;
+    const ResourceStore &r = e->resources[rids[i]];
+    if (chunks[i] < static_cast<int64_t>(r.chunk_version.size()))
+      versions_out[i] = r.chunk_version[chunks[i]];
+  }
+}
+
+// Chunk-granular grant write-back: row i of grants [n, W] applies to
+// slots [chunks[i]*W, ...) of rids[i] IF the chunk's membership version
+// still equals expected_version[i] (a stale chunk re-delivers after its
+// change re-dirties it). Grants only — expiry/refresh stay
+// client-driven; keep_has[i] != 0 preserves has (learning replay).
+// Returns chunks applied.
+int64_t dm_apply_chunks(Engine *e, const int32_t *rids,
+                        const int32_t *chunks, int64_t n, int64_t W,
+                        const double *grants, const uint8_t *keep_has,
+                        const uint64_t *expected_version) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  int64_t applied = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (!valid_rid(e, rids[i]) || chunks[i] < 0) continue;
+    ResourceStore &r = e->resources[rids[i]];
+    const uint64_t v =
+        chunks[i] < static_cast<int64_t>(r.chunk_version.size())
+            ? r.chunk_version[chunks[i]]
+            : 0;
+    if (v != expected_version[i]) continue;
+    if (!keep_has[i]) {
+      const double *g = grants + i * W;
+      const int64_t base = static_cast<int64_t>(chunks[i]) * W;
+      const int64_t filled = std::min<int64_t>(
+          W, static_cast<int64_t>(r.leases.size()) - base);
+      for (int64_t j = 0; j < filled; ++j) {
+        Lease &l = r.leases[base + j];
         r.sum_has += g[j] - l.has;
         l.has = g[j];
       }

@@ -151,6 +151,12 @@ class CapacityServer(CapacityServicer):
         self._resident_handle = None
         self._resident_ok_key = None
         self._resident_ok = False
+        # Wide lane resources (wider than the dense bucket cap) tick
+        # through their own chunked resident solver; the partition is
+        # recomputed with the eligibility key.
+        self._resident_wide = None
+        self._resident_wide_handle = None
+        self._wide_ids: set = set()
         # Bumped whenever templates / learning windows / parent leases
         # change outside the stores; the resident solver caches its
         # config reads against it.
@@ -303,11 +309,13 @@ class CapacityServer(CapacityServicer):
         self.resources = {}
         self._server_bands = {}
         self._reset_store_engine()
-        # The engine was replaced: the resident solver's device tables
-        # and any in-flight tick refer to the old one.
+        # The engine was replaced: the resident solvers' device tables
+        # and any in-flight ticks refer to the old one.
         self._config_epoch += 1
         self._resident = None
         self._resident_handle = None
+        self._resident_wide = None
+        self._resident_wide_handle = None
         self._resident_ok_key = None
 
     async def _on_current_master(self, master: str) -> None:
@@ -394,13 +402,33 @@ class CapacityServer(CapacityServicer):
             )
         return self._resident
 
+    def _resident_wide_solver(self):
+        """The chunked resident solver for lane resources wider than the
+        dense bucket cap (lazily created); requires the native engine."""
+        if self._resident_wide is None:
+            import numpy as np
+
+            from doorman_tpu.solver.resident_wide import WideResidentSolver
+
+            self._get_solver()  # settles x64 config for f64 mode
+            dtype = np.float64 if self.solver_dtype == "f64" else np.float32
+            engine = self._store_factory.__self__
+            self._resident_wide = WideResidentSolver(
+                engine, dtype=dtype, clock=self._clock,
+                rotate_ticks=None, tick_interval=self.tick_interval,
+            )
+        return self._resident_wide
+
     def _resident_eligible(self, resources: List[Resource]) -> bool:
         """The resident path covers a native batch server's lane
         (non-PRIORITY_BANDS) resources; a mixed config keeps the
         resident fast path for the lane subset while the PRIORITY_BANDS
         resources (their own dense part, group caps) tick through the
-        BatchSolver alongside it. Recomputed only when the config epoch
-        or the resource set moves."""
+        BatchSolver alongside it. Lane resources wider than the dense
+        bucket cap take the chunked wide solver — there is no width
+        limit on the resident path. Recomputed only when the config
+        epoch or the resource set moves (ResidentOverflow forces a
+        re-partition between recomputes)."""
         if not self._native_store:
             return False
         key = (self._config_epoch, len(resources))
@@ -408,19 +436,18 @@ class CapacityServer(CapacityServicer):
             from doorman_tpu.solver.batch import DENSE_MAX_K
 
             self._resident_ok_key = key
-            # The width bound applies to the LANE resources only — a
-            # wide PRIORITY_BANDS resource (band aggregation is exactly
-            # the many-client use case) never enters the resident dense
-            # bucket and must not disable the fast path for the rest.
-            # ResidentOverflow backstops lane growth between rechecks.
-            lane_widths = [
-                len(r.store)
+            lane = [
+                r
                 for r in resources
                 if algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
             ]
-            self._resident_ok = bool(lane_widths) and (
-                max(lane_widths) <= DENSE_MAX_K
-            )
+            # A wide PRIORITY_BANDS resource (band aggregation is
+            # exactly the many-client use case) never enters a resident
+            # dense bucket; only lane resources partition by width.
+            self._wide_ids = {
+                r.id for r in lane if len(r.store) > DENSE_MAX_K
+            }
+            self._resident_ok = bool(lane)
         return self._resident_ok
 
     def _resident_step(self, solver, resources: List[Resource],
@@ -452,6 +479,19 @@ class CapacityServer(CapacityServicer):
             # benign (the next step drops it uncollected).
             self._resident_handle = (solver, handle)
 
+    def _resident_wide_step(self, solver, resources: List[Resource],
+                            config_epoch: int) -> None:
+        """One pipelined wide (chunked) tick; same collect-then-dispatch
+        pipelining and flip-safety rules as _resident_step."""
+        entry, self._resident_wide_handle = self._resident_wide_handle, None
+        if entry is not None:
+            h_solver, handle = entry
+            if h_solver is solver:
+                solver.collect(handle)
+        handle = solver.dispatch(resources, config_epoch)
+        if self._resident_wide is solver:
+            self._resident_wide_handle = (solver, handle)
+
     @property
     def _ticks_done(self) -> int:
         """Applied batch ticks (the serving condition for store-backed
@@ -462,6 +502,8 @@ class CapacityServer(CapacityServicer):
         ticks = self._solver.ticks if self._solver is not None else 0
         if self._resident is not None:
             ticks = max(ticks, self._resident.ticks)
+        if self._resident_wide is not None:
+            ticks = max(ticks, self._resident_wide.ticks)
         return ticks
 
     async def tick_once(self) -> None:
@@ -509,10 +551,13 @@ class CapacityServer(CapacityServicer):
         if self._resident_eligible(resources):
             from doorman_tpu.solver.resident import ResidentOverflow
 
+            wide_ids = self._wide_ids
             lane_res = [
                 r for r in resources
                 if algo_kind_for(r.template) != AlgoKind.PRIORITY_BANDS
             ]
+            narrow_res = [r for r in lane_res if r.id not in wide_ids]
+            wide_res = [r for r in lane_res if r.id in wide_ids]
             prio_res = [
                 r for r in resources
                 if algo_kind_for(r.template) == AlgoKind.PRIORITY_BANDS
@@ -520,12 +565,23 @@ class CapacityServer(CapacityServicer):
             # Resolved HERE, on the event loop, so solver/resources/
             # epoch stay mutually consistent under a concurrent
             # mastership flip (see _resident_step).
-            resident = self._resident_solver()
+            resident = self._resident_solver() if narrow_res else None
+            wide = self._resident_wide_solver() if wide_res else None
+            if not narrow_res:
+                self._resident_handle = None
+            if not wide_res:
+                self._resident_wide_handle = None
             epoch = self._config_epoch
 
             def resident_or_fallback():
                 try:
-                    self._resident_step(resident, lane_res, epoch)
+                    if narrow_res:
+                        self._resident_step(resident, narrow_res, epoch)
+                    if wide_res:
+                        # Lane resources wider than the dense bucket cap
+                        # tick through the chunked solver (their own
+                        # device tables; the solves are independent).
+                        self._resident_wide_step(wide, wide_res, epoch)
                     if prio_res:
                         # PRIORITY_BANDS resources tick through the
                         # BatchSolver's priority part (group caps couple
@@ -538,14 +594,15 @@ class CapacityServer(CapacityServicer):
                             prio_res, snap, gets, return_grants=False
                         )
                 except ResidentOverflow:
-                    # A resource outgrew the dense bucket mid-tick;
-                    # pin this server to the BatchSolver path until the
-                    # resource set or config moves again.
+                    # A narrow lane resource outgrew the dense bucket
+                    # mid-tick: force a re-partition (it lands in the
+                    # wide set next tick) and run this tick through the
+                    # BatchSolver (correct at any width).
                     log.warning(
-                        "%s: resident solver overflow; falling back to "
-                        "the batch path", self.id,
+                        "%s: resident bucket overflow; re-partitioning "
+                        "wide resources", self.id,
                     )
-                    self._resident_ok = False
+                    self._resident_ok_key = None
                     self._resident_handle = None
                     run_tick()
 
@@ -583,6 +640,7 @@ class CapacityServer(CapacityServicer):
                 # drop it here or it pins the orphaned engine and its
                 # device buffer for the whole standby period.
                 self._resident_handle = None
+                self._resident_wide_handle = None
                 continue
             try:
                 await self.tick_once()

@@ -60,3 +60,70 @@ def solve_dense(batch: DenseBatch) -> jax.Array:
 
 
 solve_dense_jit = jax.jit(solve_dense)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ChunkedDenseBatch:
+    """The wide-resource layout: a resource wider than the bucket cap
+    spans CONSECUTIVE rows ("chunks") of the [R, K] tile; `row_seg` maps
+    each row to its resource segment, and the per-resource arrays are
+    per SEGMENT [S]. Slot s of a resource based at row b lives at
+    (b + s // K, s % K), so flat index b*K + s — which is what makes
+    slot-granular delta uploads a single 1D scatter.
+
+    Per-resource totals become a two-level reduction: row reduction on
+    the VPU (as in DenseBatch), then a tiny segment reduction over the
+    [R] row totals — rows are resource-major, so `row_seg` is sorted and
+    the segment ops take the indices_are_sorted fast path. This is the
+    same aggregation structure parallel/sharded.py uses across devices,
+    applied within one chip; it replaces the reference's O(n)-per-request
+    loop over a huge shared resource
+    (/root/reference/go/server/doorman/algorithm.go:213-292) with one
+    batched solve."""
+
+    wants: jax.Array  # [R, K]
+    has: jax.Array  # [R, K]
+    subclients: jax.Array  # [R, K]
+    active: jax.Array  # [R, K] bool
+    row_seg: jax.Array  # [R] int32, sorted; padding rows -> padding seg
+    capacity: jax.Array  # [S]
+    algo_kind: jax.Array  # [S]
+    learning: jax.Array  # [S] bool
+    static_capacity: jax.Array  # [S]
+
+
+def solve_chunked(batch: ChunkedDenseBatch) -> jax.Array:
+    """Grants [R, K]; identical lane semantics — only the reductions
+    differ (two-level instead of one row reduction)."""
+    seg = batch.row_seg
+    S = batch.capacity.shape[0]
+
+    def segsum(v):
+        return jax.ops.segment_sum(
+            v.sum(axis=1), seg, num_segments=S, indices_are_sorted=True
+        )
+
+    def segmax(v):
+        # Empty segments produce the dtype minimum; solve_lanes already
+        # guards its one segmax use (max_ratio) against non-finite.
+        return jax.ops.segment_max(
+            v.max(axis=1), seg, num_segments=S, indices_are_sorted=True
+        )
+
+    return solve_lanes(
+        batch.wants,
+        batch.has,
+        batch.subclients,
+        batch.active,
+        batch.capacity,
+        batch.algo_kind,
+        batch.learning,
+        batch.static_capacity,
+        segsum=segsum,
+        segmax=segmax,
+        expand=lambda totals: totals[seg][:, None],
+    )
+
+
+solve_chunked_jit = jax.jit(solve_chunked)

@@ -89,6 +89,9 @@ class TickHandle:
     n_sel: int = 0
     dispatched_at: float = 0.0
     collected: bool = False
+    # Wide (chunked) ticks only: the chunk number per selected row
+    # (solver.resident_wide writes back via apply_chunks).
+    chunks: "np.ndarray | None" = None
 
 
 class ResidentDenseSolver:

@@ -1,0 +1,573 @@
+"""Device-resident tick solver for WIDE resources (chunked layout).
+
+Doorman's headline use case is ONE shared resource with a huge client
+population (/root/reference/doc/design.md:218 — thousands of clients on
+a shared resource; the reference solves it with an O(n) loop per
+request, /root/reference/go/server/doorman/algorithm.go:213-292). The
+narrow resident solver (solver/resident.py) maps one resource to one
+device row, which caps per-resource width at the dense bucket cap; this
+module removes that cap by letting a resource span CONSECUTIVE rows
+("chunks") of the [R, W] table — slot s of a resource based at row b
+lives at flat index b*W + s — and solving with the two-level reduction
+in solver.dense.solve_chunked.
+
+What crosses the host<->device link per tick (the link is the tick's
+bottleneck at 1M leases, and the whole point of this layout):
+
+  upload:   individual dirty SLOTS as one flat 1D scatter (the engine
+            tracks dirtiness per slot for chunk-tracked resources, so a
+            single client's wants change ships 8 bytes, not a
+            million-lease table). Wants-only churn ships just the wants
+            value; slots whose shape changed (membership, has,
+            subclients) ship all four lanes.
+  solve:    the full table every tick; `has` chains on device.
+  download: chunk rows being DELIVERED this tick: rows containing
+            full-dirty slots (membership / client-reported has — these
+            must land in the store promptly), every row of a resource
+            whose effective config changed (same-tick config freshness,
+            matching the narrow solver and reference
+            go/server/doorman/resource.go:117-140), plus a rotating
+            slice covering the whole table every `rotate_ticks` ticks.
+            Wants-driven grant movement rides the ROTATION rather than
+            forcing same-tick delivery: with a shared waterfill level,
+            any demand change moves EVERY client's grant, so same-tick
+            delivery of "changed" grants would re-download the entire
+            table every tick. The rotation bound — every lease's stored
+            grant is at most `rotate_ticks` ticks (<= one refresh
+            interval) stale — is exactly the information-staleness the
+            reference already has (client-reported `has` lags by a
+            refresh interval, go/server/doorman/server.go:732-817).
+            When the dirty-row set is small it IS delivered same-tick
+            (narrow-solver freshness at low churn); a byte budget keeps
+            scattered churn from degenerating into full-table delivery.
+
+Write-back safety: chunk membership versions are read after the slot
+drain and before the pack (StoreEngine.chunk_versions), so an apply's
+expected version can lag the device state but never lead it — a
+mid-flight membership change makes the apply skip that chunk and the
+re-marked slots re-deliver it next tick.
+
+Same dispatch/collect/step surface as ResidentDenseSolver; the server
+runs one of each when a config mixes narrow and wide resources.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
+from doorman_tpu.core.snapshot import _bucket
+from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
+from doorman_tpu.solver.resident import TickHandle, _ceil_to
+
+
+class WideResidentSolver:
+    """Steady-state batched ticks for resources wider than the dense
+    bucket cap, with the device as the table of record.
+
+    Covers lane-algorithm resources backed by one native StoreEngine;
+    the caller partitions: narrow lane resources -> ResidentDenseSolver,
+    PRIORITY_BANDS -> BatchSolver priority part, wide lane -> here.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        dtype=np.float32,
+        device=None,
+        clock: Callable[[], float] = time.time,
+        rotate_ticks: "int | None" = None,
+        tick_interval: "float | None" = None,
+        download_dtype=None,
+        chunk_width: "int | None" = None,
+    ):
+        import jax
+
+        if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "WideResidentSolver dtype=float64 requires jax_enable_x64"
+            )
+        self._engine = engine
+        self._dtype = np.dtype(dtype)
+        self._device = device
+        self._clock = clock
+        self._W = int(chunk_width or DENSE_MAX_K)
+        self._tick_interval = tick_interval
+        self._rotate_override: "int | None" = None
+        if rotate_ticks is None:
+            self._rotate = 8
+        else:
+            self.rotate_ticks = rotate_ticks
+        self._out_dtype = download_dtype or self._dtype
+        self.ticks = 0
+        self.idle_ticks = 0
+        self.last_tick_seconds = 0.0
+        self._quiet_ticks = 0
+        self.phase_s: Dict[str, float] = {
+            name: 0.0
+            for name in (
+                "sweep", "drain", "config", "pack", "upload", "launch",
+                "download", "apply",
+            )
+        }
+
+        self._res: List[Resource] = []
+        self._S = 0  # segments (resources)
+        self._Sp = 8
+        self._R = 0  # real chunk rows
+        self._Rp = 0  # padded rows
+        self._base_row = np.zeros(0, np.int64)  # per segment
+        self._n_chunks = np.zeros(0, np.int64)  # per segment
+        self._row_rids = np.zeros(0, np.int32)  # per row (-1 padding)
+        self._row_chunk = np.zeros(0, np.int32)  # per row (-1 padding)
+        self._row_seg_h = np.zeros(0, np.int32)  # per row (Sp-1 padding)
+        self._rid_to_seg: Dict[int, int] = {}
+
+        # Device tables (donated through each tick executable).
+        self._wants = self._has = self._sub = self._act = None
+        self._row_seg_d = None
+        # Per-segment config, host mirror + device handle.
+        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
+        self._cap_d = self._kind_d = self._statc_d = self._learn_d = None
+        self._refresh = None
+        self._cap_raw = self._learn_end = self._parent_exp = None
+        self._config_epoch = -1
+        self._rot_cursor = 0
+        self._just_rebuilt = False
+
+        self._tick_fns: Dict[Tuple[int, int, int], Callable] = {}
+
+    # -- configuration ------------------------------------------------
+
+    @property
+    def rotate_ticks(self) -> int:
+        return self._rotate
+
+    @rotate_ticks.setter
+    def rotate_ticks(self, value: int) -> None:
+        self._rotate_override = max(int(value), 1)
+        self._rotate = self._rotate_override
+
+    def _put(self, arr):
+        import jax
+
+        return jax.device_put(arr, self._device)
+
+    # -- config tracking (per SEGMENT; the narrow solver's per-row
+    # equivalents are resident.py:194-274 — same cadence rules) --------
+
+    def _read_config(self, res: Sequence[Resource]) -> None:
+        Sp = self._Sp
+        dtype = self._dtype
+        cap = np.zeros(Sp, dtype)
+        kind = np.zeros(Sp, np.int32)
+        statc = np.zeros(Sp, dtype)
+        refresh = np.full(Sp, 1.0, np.float64)
+        learn_end = np.zeros(Sp, np.float64)
+        parent_exp = np.full(Sp, np.inf, np.float64)
+        for i, r in enumerate(res):
+            tpl = r.template
+            cap[i] = tpl.capacity
+            kind[i] = algo_kind_for(tpl)
+            statc[i] = static_param(tpl)
+            refresh[i] = float(tpl.algorithm.refresh_interval)
+            learn_end[i] = r.learning_mode_end
+            if r.parent_expiry is not None:
+                parent_exp[i] = r.parent_expiry
+        self._cap_raw = cap
+        self._learn_end = learn_end
+        self._parent_exp = parent_exp
+        self._refresh = refresh
+        if self._rotate_override is None and self._tick_interval and res:
+            # Delivery covers the table at least once per refresh
+            # interval (capped at 64 — see resident.py:219-235).
+            self._rotate = max(
+                1,
+                min(
+                    int(refresh[: len(res)].min() / self._tick_interval),
+                    64,
+                ),
+            )
+        if self._kind_h is None or not np.array_equal(kind, self._kind_h):
+            self._kind_h, self._kind_d = kind, self._put(kind)
+        if self._statc_h is None or not np.array_equal(statc, self._statc_h):
+            self._statc_h, self._statc_d = statc, self._put(statc)
+
+    def _refresh_config(
+        self, res: Sequence[Resource], config_epoch: int, now: float
+    ) -> "np.ndarray | None":
+        """Per-tick config view; returns SEGMENTS whose effective config
+        changed this tick (their rows must all deliver this tick), or
+        None for "everything may have changed" (epoch move / first
+        tick). Same semantics as resident.py:241-274."""
+        epoch_moved = (
+            config_epoch != self._config_epoch or self._cap_raw is None
+        )
+        if epoch_moved:
+            self._config_epoch = config_epoch
+            self._read_config(res)
+        cap = np.where(
+            self._parent_exp < now, 0.0, self._cap_raw
+        ).astype(self._dtype)
+        learn = self._learn_end > now
+        if epoch_moved or self._cap_h is None or self._learn_h is None:
+            changed: "np.ndarray | None" = None
+        else:
+            mask = (cap != self._cap_h) | (learn != self._learn_h)
+            changed = np.nonzero(mask)[0]
+        if self._cap_h is None or not np.array_equal(cap, self._cap_h):
+            self._cap_h, self._cap_d = cap, self._put(cap)
+        if self._learn_h is None or not np.array_equal(learn, self._learn_h):
+            self._learn_h, self._learn_d = learn, self._put(learn)
+        return changed
+
+    # -- build / rebuild ----------------------------------------------
+
+    def rebuild(self, resources: Sequence[Resource]) -> None:
+        """Full pack: size the chunk map from live counts, install the
+        engine's chunk tracking, and upload every table."""
+        res = list(resources)
+        self._res = res
+        self._S = len(res)
+        self._Sp = _bucket(self._S + 1, 8)
+        W = self._W
+        counts = np.array([len(r.store) for r in res], np.int64)
+        self._n_chunks = np.maximum(1, -(-counts // W))
+        self._base_row = np.zeros(self._S + 1, np.int64)
+        np.cumsum(self._n_chunks, out=self._base_row[1:])
+        self._R = int(self._base_row[-1])
+        # +1 reserves a padding row for no-op scatters.
+        self._Rp = _round_rows(self._R + 1)
+        self._row_rids = np.full(self._Rp, -1, np.int32)
+        self._row_chunk = np.full(self._Rp, -1, np.int32)
+        # Padding rows resolve to the reserved padding segment Sp-1
+        # (capacity 0, all lanes inactive).
+        self._row_seg_h = np.full(self._Rp, self._Sp - 1, np.int32)
+        self._rid_to_seg = {}
+        for i, r in enumerate(res):
+            b, n = self._base_row[i], self._n_chunks[i]
+            self._row_rids[b : b + n] = r.store._rid
+            self._row_chunk[b : b + n] = np.arange(n, dtype=np.int32)
+            self._row_seg_h[b : b + n] = i
+            self._rid_to_seg[r.store._rid] = i
+        # row_seg must stay sorted for the segment ops' sorted fast
+        # path: padding segment Sp-1 >= every real segment. (True by
+        # construction; cheap to assert while packing is host-side.)
+        assert (np.diff(self._row_seg_h) >= 0).all()
+
+        # Install tracking, then pack. Writes landing between the two
+        # calls mark slot dirt that survives to the next drain AND are
+        # already included in the pack (it reads live state) — a benign
+        # double-upload, never a miss.
+        self._engine.chunk_config(
+            np.asarray([r.store._rid for r in res], np.int32), W
+        )
+        w, h, s, act, _filled, versions = self._engine.pack_chunks(
+            self._row_rids[: self._R], self._row_chunk[: self._R], W
+        )
+        dtype = self._dtype
+        pad = ((0, self._Rp - self._R), (0, 0))
+        self._wants = self._put(np.pad(w, pad).astype(dtype))
+        self._has = self._put(np.pad(h, pad).astype(dtype))
+        self._sub = self._put(np.pad(s, pad).astype(dtype))
+        self._act = self._put(np.pad(act, pad).astype(bool))
+        self._row_seg_d = self._put(self._row_seg_h)
+        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
+        self._cap_raw = None
+        self._refresh_config(res, self._config_epoch, self._clock())
+        self._rot_cursor = 0
+        self._just_rebuilt = True
+        self._tick_fns.clear()
+
+    def _needs_rebuild(self, resources: List[Resource]) -> bool:
+        if len(resources) != self._S or any(
+            a is not b for a, b in zip(resources, self._res)
+        ):
+            return True
+        # Growth past the allocated chunks: sized from live counts (one
+        # C sums call per wide resource — there are few by nature).
+        for i, r in enumerate(self._res):
+            if len(r.store) > self._n_chunks[i] * self._W:
+                return True
+        return False
+
+    # -- the tick executable ------------------------------------------
+
+    def _tick_fn(self, Dw: int, Df: int, Sb: int):
+        key = (Dw, Df, Sb)
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        from functools import partial
+
+        from doorman_tpu.solver.dense import (
+            ChunkedDenseBatch,
+            solve_chunked,
+        )
+
+        Rp, W = self._Rp, self._W
+        out_dtype = self._out_dtype
+        row_seg = self._row_seg_d
+
+        # Flat 1D scatters: slot s of the segment based at row b lives
+        # at flat index b*W + s. Wants-only slots (`w_*`, the
+        # steady-state churn) ship one value each; full slots (`f_*`)
+        # ship all four lanes. Reshape in/out of [Rp*W] is free (same
+        # buffer); donation keeps the tables in place.
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(wants, has, sub, act, w_idx, w_val, f_idx, f_w, f_h,
+                 f_s, f_a, sel_idx, cap, kind, learn, statc):
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val)
+                .at[f_idx].set(f_w)
+                .reshape(Rp, W)
+            )
+            has = has.reshape(-1).at[f_idx].set(f_h).reshape(Rp, W)
+            sub = sub.reshape(-1).at[f_idx].set(f_s).reshape(Rp, W)
+            act = act.reshape(-1).at[f_idx].set(f_a).reshape(Rp, W)
+            gets = solve_chunked(
+                ChunkedDenseBatch(
+                    wants=wants, has=has, subclients=sub, active=act,
+                    row_seg=row_seg, capacity=cap, algo_kind=kind,
+                    learning=learn, static_capacity=statc,
+                )
+            )
+            out = gets[sel_idx, :].astype(out_dtype)
+            return wants, gets, sub, act, out
+
+        self._tick_fns[key] = tick
+        return tick
+
+    # -- phases -------------------------------------------------------
+
+    def dispatch(
+        self, resources: Sequence[Resource], config_epoch: int = 0
+    ) -> TickHandle:
+        """Host+device phase: sweep, drain dirty slots, upload the
+        deltas, launch the solve, start the delivery download. Safe to
+        run in an executor thread (the engine is mutex-guarded)."""
+        t0 = time.perf_counter()
+        ph = self.phase_s
+
+        def lap(name):
+            nonlocal t0
+            t1 = time.perf_counter()
+            ph[name] = ph.get(name, 0.0) + (t1 - t0)
+            t0 = t1
+
+        now = self._clock()
+        self._engine.clean_all(now)
+        lap("sweep")
+        res_list = list(resources)
+        if self._wants is None or self._needs_rebuild(res_list):
+            self.rebuild(res_list)
+            t0 = time.perf_counter()
+
+        # Drain dirty slots of our tracked rids. (drain FIRST, then
+        # read versions, then pack — see StoreEngine.chunk_versions.)
+        W = self._W
+        slot_parts: List[np.ndarray] = []  # flat device indices
+        lvl_parts: List[np.ndarray] = []
+        rid_parts: List[np.ndarray] = []  # rid per drained slot
+        raw_slot_parts: List[np.ndarray] = []  # engine slot per drained
+        for rid in self._engine.dirty_slot_rids():
+            seg = self._rid_to_seg.get(int(rid))
+            if seg is None:
+                continue
+            slots, levels = self._engine.drain_slots(int(rid))
+            if not len(slots):
+                continue
+            # Slots past the allocated chunk span (growth raced the
+            # rebuild check) force a rebuild next tick via
+            # _needs_rebuild; clamp here so this tick stays in-bounds.
+            limit = int(self._n_chunks[seg]) * W
+            keep = slots < limit
+            slots = slots[keep]
+            levels = levels[keep]
+            slot_parts.append(self._base_row[seg] * W + slots)
+            lvl_parts.append(levels)
+            rid_parts.append(np.full(len(slots), rid, np.int32))
+            raw_slot_parts.append(slots)
+        if slot_parts:
+            flat_idx = np.concatenate(slot_parts)
+            levels = np.concatenate(lvl_parts)
+            slot_rids = np.concatenate(rid_parts)
+            raw_slots = np.concatenate(raw_slot_parts)
+        else:
+            flat_idx = np.zeros(0, np.int64)
+            levels = np.zeros(0, np.uint8)
+            slot_rids = np.zeros(0, np.int32)
+            raw_slots = np.zeros(0, np.int64)
+        lap("drain")
+        config_changed = self._refresh_config(res_list, config_epoch, now)
+        lap("config")
+
+        # Idle fast path: same two-rotation rule as the narrow solver
+        # (resident.py:454-484 documents why two).
+        quiet = (
+            len(flat_idx) == 0
+            and not self._just_rebuilt
+            and config_changed is not None
+            and len(config_changed) == 0
+        )
+        if quiet:
+            self._quiet_ticks += 1
+            if self._quiet_ticks > max(2 * self.rotate_ticks,
+                                       self.rotate_ticks + 3):
+                return TickHandle(
+                    out=None,
+                    sel_rows=np.zeros(0, np.int64),
+                    rids=np.zeros(0, np.int32),
+                    versions=np.zeros(0, np.uint64),
+                    keep_has=np.zeros(0, np.uint8),
+                    n_sel=0,
+                    dispatched_at=now,
+                )
+        else:
+            self._quiet_ticks = 0
+
+        # Delivery set (chunk rows). Full-dirty rows (membership /
+        # client-reported has) and config-changed segments always
+        # deliver same-tick; wants-dirty rows deliver same-tick only
+        # while the set stays small (beyond the budget the rotation
+        # covers them within a refresh interval — the module docstring
+        # explains why that bound is the reference's own staleness).
+        full_mask = levels >= 2
+        dirty_rows = flat_idx // W
+        rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
+        rot = (
+            self._rot_cursor + np.arange(rot_block, dtype=np.int64)
+        ) % max(self._R, 1)
+        if self._just_rebuilt or config_changed is None:
+            self._just_rebuilt = False
+            sel = np.arange(max(self._R, 1), dtype=np.int64)
+        else:
+            parts = [dirty_rows[full_mask], rot]
+            budget = max(64, 2 * rot_block)
+            wants_rows = np.unique(dirty_rows[~full_mask])
+            if len(wants_rows) <= budget:
+                parts.append(wants_rows)
+            for s in config_changed:
+                if s < self._S:
+                    b, n = self._base_row[s], self._n_chunks[s]
+                    parts.append(np.arange(b, b + n, dtype=np.int64))
+            sel = np.unique(np.concatenate(parts))
+        self._rot_cursor = (self._rot_cursor + rot_block) % max(self._R, 1)
+        n_sel = len(sel)
+        sel_rids = self._row_rids[sel]
+        sel_chunks = self._row_chunk[sel]
+        # Versions BEFORE the pack (safe direction; see chunk_versions).
+        versions = self._engine.chunk_versions(sel_rids, sel_chunks)
+
+        # Pack the dirty slots' values (one gather call per rid).
+        n_w = int((~full_mask).sum())
+        n_f = int(full_mask.sum())
+        Dw = _ceil_to(n_w, 1024)
+        Df = _ceil_to(n_f, 256)
+        Sb = _ceil_to(n_sel, 32)
+        dtype = self._dtype
+        w_idx = np.full(Dw, self._R * W, np.int64)  # padding row slot 0
+        w_val = np.zeros(Dw, dtype)
+        f_idx = np.full(Df, self._R * W, np.int64)
+        f_w = np.zeros(Df, dtype)
+        f_h = np.zeros(Df, dtype)
+        f_s = np.zeros(Df, dtype)
+        f_a = np.zeros(Df, bool)
+        wpos = fpos = 0
+        for rid in np.unique(slot_rids) if len(slot_rids) else ():
+            m = slot_rids == rid
+            pw, phas, psub, pact = self._engine.pack_slots(
+                int(rid), raw_slots[m]
+            )
+            fm = full_mask[m]
+            fi = flat_idx[m]
+            nw_i = int((~fm).sum())
+            nf_i = int(fm.sum())
+            w_idx[wpos : wpos + nw_i] = fi[~fm]
+            w_val[wpos : wpos + nw_i] = pw[~fm]
+            wpos += nw_i
+            f_idx[fpos : fpos + nf_i] = fi[fm]
+            f_w[fpos : fpos + nf_i] = pw[fm]
+            f_h[fpos : fpos + nf_i] = phas[fm]
+            f_s[fpos : fpos + nf_i] = psub[fm]
+            f_a[fpos : fpos + nf_i] = pact[fm].astype(bool)
+            fpos += nf_i
+        sel_pad = np.resize(sel, Sb) if n_sel else np.zeros(Sb, np.int64)
+        lap("pack")
+
+        put = self._put
+        tick = self._tick_fn(Dw, Df, Sb)
+        staged = (
+            put(w_idx), put(w_val), put(f_idx), put(f_w), put(f_h),
+            put(f_s), put(f_a), put(sel_pad.astype(np.int32)),
+        )
+        lap("upload")
+        (
+            self._wants, self._has, self._sub, self._act, out
+        ) = tick(
+            self._wants, self._has, self._sub, self._act,
+            *staged,
+            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+        )
+        from doorman_tpu.utils.transfer import start_download
+
+        out = start_download(out)
+        lap("launch")
+        keep = np.zeros(n_sel, np.uint8)
+        if n_sel:
+            segs = self._row_seg_h[sel]
+            keep = self._learn_h[segs].astype(np.uint8)
+        return TickHandle(
+            out=out,
+            sel_rows=sel,
+            rids=sel_rids,
+            versions=versions,
+            keep_has=keep,
+            n_sel=n_sel,
+            dispatched_at=now,
+            chunks=sel_chunks,
+        )
+
+    def collect(self, handle: TickHandle) -> int:
+        """Write one tick's downloaded grant rows back into the engine;
+        chunks whose membership version moved mid-flight are skipped
+        (their re-marked slots re-deliver them next tick)."""
+        from doorman_tpu.utils.transfer import land_parts
+
+        if handle.collected:
+            return 0
+        handle.collected = True
+        if handle.out is None:
+            self.ticks += 1
+            self.idle_ticks += 1
+            self.last_tick_seconds = self._clock() - handle.dispatched_at
+            return 0
+        t0 = time.perf_counter()
+        gets = land_parts(handle.out)
+        gets = np.asarray(gets, np.float64)[: handle.n_sel]
+        t1 = time.perf_counter()
+        self.phase_s["download"] += t1 - t0
+        applied = self._engine.apply_chunks(
+            handle.rids,
+            handle.chunks,
+            gets,
+            handle.keep_has,
+            handle.versions,
+        )
+        self.phase_s["apply"] += time.perf_counter() - t1
+        self.ticks += 1
+        self.last_tick_seconds = self._clock() - handle.dispatched_at
+        return applied
+
+    def step(
+        self, resources: Sequence[Resource], config_epoch: int = 0
+    ) -> int:
+        """Sequential convenience: dispatch + collect immediately."""
+        return self.collect(self.dispatch(resources, config_epoch))

@@ -1,0 +1,107 @@
+"""solve_chunked (the wide-resource two-level layout) vs solve_dense
+and the numpy oracles. When every resource is exactly one chunk the two
+layouts must agree BYTE-identically (segment_sum over singleton sorted
+segments adds nothing); multi-chunk resources are held to the oracle
+within float-reassociation tolerance."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+import jax
+
+from doorman_tpu.algorithms.tick import oracle_row
+from doorman_tpu.solver.dense import (
+    ChunkedDenseBatch,
+    DenseBatch,
+    solve_chunked_jit,
+    solve_dense_jit,
+)
+
+
+def random_dense(rng, R=16, K=8):
+    n = rng.integers(1, K + 1, R)
+    act = np.arange(K)[None, :] < n[:, None]
+    wants = rng.random((R, K)) * 100 * act
+    has = rng.random((R, K)) * 50 * act
+    sub = rng.integers(1, 4, (R, K)) * act
+    cap = rng.random(R) * 400 + 10
+    kind = rng.choice(np.array([0, 1, 2, 3, 4], np.int32), R)
+    statc = rng.random(R) * 40
+    learning = np.zeros(R, bool)
+    return wants, has, sub, act, cap, kind, learning, statc
+
+
+def test_single_chunk_matches_dense_exactly():
+    rng = np.random.default_rng(5)
+    wants, has, sub, act, cap, kind, learning, statc = random_dense(rng)
+    dense = DenseBatch(
+        wants=wants, has=has, subclients=sub.astype(float), active=act,
+        capacity=cap, algo_kind=kind, learning=learning,
+        static_capacity=statc,
+    )
+    chunked = ChunkedDenseBatch(
+        wants=wants, has=has, subclients=sub.astype(float), active=act,
+        row_seg=np.arange(16, dtype=np.int32),
+        capacity=cap, algo_kind=kind, learning=learning,
+        static_capacity=statc,
+    )
+    a = np.asarray(solve_dense_jit(dense))
+    b = np.asarray(solve_chunked_jit(chunked))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("kind", [0, 1, 2, 3, 4])
+def test_multi_chunk_matches_oracle(kind):
+    """One resource of 37 clients split over 5 chunk rows of width 8,
+    plus a padding row mapped to a padding segment."""
+    rng = np.random.default_rng(kind + 10)
+    n, K = 37, 8
+    R = 6  # 5 data rows + 1 padding row
+    wants_f = rng.random(n) * 100
+    has_f = rng.random(n) * 50
+    sub_f = rng.integers(1, 4, n).astype(float)
+    cap = 600.0
+    statc = 30.0
+
+    wants = np.zeros((R, K))
+    has = np.zeros((R, K))
+    sub = np.zeros((R, K))
+    act = np.zeros((R, K), bool)
+    rows = np.arange(n) // K
+    lanes = np.arange(n) % K
+    wants[rows, lanes] = wants_f
+    has[rows, lanes] = has_f
+    sub[rows, lanes] = sub_f
+    act[rows, lanes] = True
+    row_seg = np.array([0, 0, 0, 0, 0, 1], np.int32)
+    batch = ChunkedDenseBatch(
+        wants=wants, has=has, subclients=sub, active=act, row_seg=row_seg,
+        capacity=np.array([cap, 0.0]),
+        algo_kind=np.array([kind, 0], np.int32),
+        learning=np.zeros(2, bool),
+        static_capacity=np.array([statc, 0.0]),
+    )
+    gets = np.asarray(solve_chunked_jit(batch))
+    expected = oracle_row(kind, cap, statc, wants_f, has_f, sub_f)
+    np.testing.assert_allclose(
+        gets[rows, lanes], expected, rtol=1e-9, atol=1e-12
+    )
+    # Padding row and inactive lanes produce zeros.
+    assert (gets[5] == 0).all()
+    assert gets[4, 5:].sum() == 0
+
+
+def test_learning_segment_replays_has():
+    rng = np.random.default_rng(2)
+    wants, has, sub, act, cap, kind, _, statc = random_dense(rng, R=4)
+    learning = np.array([True, False, True, False])
+    batch = ChunkedDenseBatch(
+        wants=wants, has=has, subclients=sub.astype(float), active=act,
+        row_seg=np.arange(4, dtype=np.int32), capacity=cap,
+        algo_kind=kind, learning=learning, static_capacity=statc,
+    )
+    gets = np.asarray(solve_chunked_jit(batch))
+    np.testing.assert_array_equal(gets[0], has[0] * act[0])
+    np.testing.assert_array_equal(gets[2], has[2] * act[2])

@@ -1,0 +1,176 @@
+"""Engine-level wide-resource (chunked) tracking: slot-granular dirty
+lists, per-chunk membership versions, and the chunk pack/apply calls
+(native/store.cc dm_chunk_* / dm_*_slots). The wide resident solver
+(solver/resident_wide.py) is built on exactly these guarantees."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+
+def make_engine(n=20, W=8):
+    eng = native.StoreEngine()
+    st = eng.store("wide")
+    for c in range(n):
+        st.assign(f"c{c}", 60.0, 5.0, 0.0, float(c + 1), 1)
+    eng.chunk_config(np.array([st._rid], np.int32), W)
+    return eng, st
+
+
+def test_pack_chunks_layout_and_fill():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    w, h, s, a, filled, ver = eng.pack_chunks(
+        np.array([rid] * 3, np.int32), np.arange(3, dtype=np.int32), 8
+    )
+    assert list(filled) == [8, 8, 4]
+    assert list(ver) == [0, 0, 0]
+    # Slot s lives at (chunk s // W, lane s % W), insertion order.
+    np.testing.assert_array_equal(w[0], np.arange(1, 9))
+    np.testing.assert_array_equal(w[2][:4], [17, 18, 19, 20])
+    assert (a[2][4:] == 0).all() and (w[2][4:] == 0).all()
+
+
+def test_slot_dirty_levels_and_drain():
+    eng, st = make_engine()
+    rid = st._rid
+    # wants-only change -> level 1.
+    st.assign("c5", 60.0, 5.0, 0.0, 99.0, 1)
+    assert list(eng.dirty_slot_rids()) == [rid]
+    slots, lvl = eng.drain_slots(rid)
+    assert list(slots) == [5] and list(lvl) == [1]
+    # Drain cleared it.
+    assert len(eng.dirty_slot_rids()) == 0
+    slots, lvl = eng.drain_slots(rid)
+    assert len(slots) == 0
+    # has change -> level 2 (full).
+    st.assign("c5", 60.0, 5.0, 7.0, 99.0, 1)
+    slots, lvl = eng.drain_slots(rid)
+    assert list(slots) == [5] and list(lvl) == [2]
+    # Grant delivery (regrant) does NOT dirty a slot.
+    st.regrant("c5", 3.0)
+    assert len(eng.dirty_slot_rids()) == 0
+
+
+def test_slot_channel_independent_of_resource_channel():
+    """The narrow resident solver drains per-resource dirt; the wide
+    solver drains per-slot dirt. Draining one channel must not consume
+    the other."""
+    eng, st = make_engine()
+    rid = st._rid
+    eng.drain_dirty2()  # clear the population's marks
+    eng.drain_slots(rid)
+    st.assign("c3", 60.0, 5.0, 0.0, 55.0, 1)
+    rids, _full = eng.drain_dirty2()
+    assert list(rids) == [rid]
+    # The slot channel still has it.
+    slots, lvl = eng.drain_slots(rid)
+    assert list(slots) == [3]
+    # And vice versa: a new write, slot drain first.
+    st.assign("c4", 60.0, 5.0, 0.0, 56.0, 1)
+    slots, _ = eng.drain_slots(rid)
+    assert list(slots) == [4]
+    rids, _full = eng.drain_dirty2()
+    assert list(rids) == [rid]
+
+
+def test_release_marks_both_touched_slots_and_bumps_versions():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    eng.drain_slots(rid)
+    # Swap-remove slot 3: last slot (19) moves into 3; both chunks'
+    # membership changed (chunk 0 and chunk 2).
+    st.release("c3")
+    slots, lvl = eng.drain_slots(rid)
+    assert set(slots) == {3, 19} and (lvl == 2).all()
+    ver = eng.chunk_versions(
+        np.array([rid] * 3, np.int32), np.arange(3, dtype=np.int32)
+    )
+    assert list(ver) == [1, 0, 1]
+    # The vacated slot packs as inactive zeros (that upload clears the
+    # lane on device).
+    pw, ph, ps, pa = eng.pack_slots(rid, np.array([19], np.int64))
+    assert pa[0] == 0 and pw[0] == 0
+
+
+def test_insert_bumps_only_its_chunk():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    eng.drain_slots(rid)
+    st.assign("new", 60.0, 5.0, 0.0, 1.0, 1)  # slot 20 -> chunk 2
+    slots, lvl = eng.drain_slots(rid)
+    assert list(slots) == [20] and list(lvl) == [2]
+    ver = eng.chunk_versions(
+        np.array([rid] * 3, np.int32), np.arange(3, dtype=np.int32)
+    )
+    assert list(ver) == [0, 0, 1]
+
+
+def test_apply_chunks_version_guard():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    rids = np.array([rid] * 3, np.int32)
+    chunks = np.arange(3, dtype=np.int32)
+    ver = eng.chunk_versions(rids, chunks)
+    st.release("c3")  # bumps chunks 0 and 2
+    grants = np.full((3, 8), 7.0)
+    applied = eng.apply_chunks(
+        rids, chunks, grants, np.zeros(3, np.uint8), ver
+    )
+    assert applied == 1  # only chunk 1 still matches
+    assert st.get("c8").has == 7.0  # chunk 1, slot 8
+    assert st.get("c0").has == 0.0  # chunk 0 skipped
+    # keep_has preserves even matching chunks (learning replay).
+    ver = eng.chunk_versions(rids, chunks)
+    applied = eng.apply_chunks(
+        rids, chunks, grants * 0 + 9.0, np.ones(3, np.uint8), ver
+    )
+    assert applied == 3
+    assert st.get("c8").has == 7.0
+
+
+def test_apply_chunks_keeps_running_sums_consistent():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    rids = np.array([rid] * 3, np.int32)
+    chunks = np.arange(3, dtype=np.int32)
+    ver = eng.chunk_versions(rids, chunks)
+    grants = np.tile(np.arange(8, dtype=np.float64), (3, 1))
+    eng.apply_chunks(rids, chunks, grants, np.zeros(3, np.uint8), ver)
+    expected = sum(float(l.has) for _, l in st.items())
+    assert st.sum_has == pytest.approx(expected)
+    # Only 20 slots live: the last chunk's padding lanes wrote nothing.
+    assert st.sum_has == pytest.approx(2 * sum(range(8)) + sum(range(4)))
+
+
+def test_chunk_config_reset_clears_state():
+    eng, st = make_engine(n=20, W=8)
+    rid = st._rid
+    st.assign("c2", 60.0, 5.0, 0.0, 77.0, 1)
+    # Reconfigure (e.g. a rebuild with a new width): dirt and versions
+    # reset; the caller repacks everything immediately after.
+    eng.chunk_config(np.array([rid], np.int32), 16)
+    assert len(eng.dirty_slot_rids()) == 0
+    ver = eng.chunk_versions(
+        np.array([rid] * 2, np.int32), np.arange(2, dtype=np.int32)
+    )
+    assert list(ver) == [0, 0]
+
+
+def test_untracked_resources_cost_nothing():
+    eng = native.StoreEngine()
+    st = eng.store("narrow")
+    for c in range(5):
+        st.assign(f"c{c}", 60.0, 5.0, 0.0, 1.0, 1)
+    # No chunk_config: writes must not accumulate slot dirt.
+    st.assign("c0", 60.0, 5.0, 0.0, 2.0, 1)
+    assert len(eng.dirty_slot_rids()) == 0
+    slots, _ = eng.drain_slots(st._rid)
+    assert len(slots) == 0
