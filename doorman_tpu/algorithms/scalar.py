@@ -44,6 +44,24 @@ def _params(config: pb.Algorithm) -> tuple[float, float]:
     return float(config.lease_length), float(config.refresh_interval)
 
 
+def _peek(store: LeaseStore, client: str):
+    """(found, lease, sum_has, sum_wants, count) — in ONE store call
+    when the store provides the combined read (the native store's
+    request path pays a ctypes crossing per primitive read; see
+    NativeLeaseStore.peek), else composed from the primitives. A pure
+    read combination: semantics identical either way."""
+    peek = getattr(store, "peek", None)
+    if peek is not None:
+        return peek(client)
+    return (
+        store.has_client(client),
+        store.get(client),
+        store.sum_has,
+        store.sum_wants,
+        store.count,
+    )
+
+
 def no_algorithm(config: pb.Algorithm) -> Algorithm:
     """Every client gets exactly what it wants."""
     length, interval = _params(config)
@@ -90,10 +108,10 @@ def proportional_share(config: pb.Algorithm) -> Algorithm:
     length, interval = _params(config)
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
-        old = store.get(r.client)
+        _, old, sum_has, sum_wants, _count = _peek(store, r.client)
         # The requester's own outstanding lease does not count against it.
-        all_wants = store.sum_wants - old.wants + r.wants
-        sum_leases = store.sum_has - old.has
+        all_wants = sum_wants - old.wants + r.wants
+        sum_leases = sum_has - old.has
         free = max(capacity - sum_leases, 0.0)
         if all_wants < capacity:
             gets = min(r.wants, free)
@@ -114,18 +132,17 @@ def proportional_topup(config: pb.Algorithm) -> Algorithm:
     length, interval = _params(config)
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
-        old = store.get(r.client)
-        count = store.count
-        if not store.has_client(r.client):
+        found, old, sum_has, sum_wants, count = _peek(store, r.client)
+        if not found:
             count += r.subclients
 
         equal_share = capacity / count
         equal_share_client = equal_share * r.subclients
         # Capacity not currently promised to anyone else; the hard cap on
         # what this run may grant.
-        unused = capacity - store.sum_has + old.has
+        unused = capacity - sum_has + old.has
 
-        if store.sum_wants <= capacity or r.wants <= equal_share_client:
+        if sum_wants <= capacity or r.wants <= equal_share_client:
             return store.assign(
                 r.client, length, interval,
                 min(r.wants, unused), r.wants, r.subclients,
@@ -167,15 +184,15 @@ def fair_share(config: pb.Algorithm) -> Algorithm:
     length, interval = _params(config)
 
     def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
-        old = store.get(r.client)
+        _, old, sum_has, _sum_wants, count0 = _peek(store, r.client)
         if r.has != old.has:
             log.error(
                 "client %s is confused: says it has %s, was assigned %s",
                 r.client, r.has, old.has,
             )
 
-        count = store.count - old.subclients + r.subclients
-        available = capacity - store.sum_has + old.has
+        count = count0 - old.subclients + r.subclients
+        available = capacity - sum_has + old.has
         equal_share = capacity / count
         deserved = equal_share * r.subclients
 

@@ -26,6 +26,10 @@ log = logging.getLogger(__name__)
 
 CAPACITY_QUEUE_SIZE = 32
 
+# Upper bound on one bulk-refresh RPC attempt (including the
+# connection's internal redirect/retry chasing); see _perform_requests.
+REFRESH_RPC_BOUND = 30.0
+
 _id_counter = 0
 
 
@@ -55,6 +59,13 @@ class ClientResource:
         self.priority = priority
         self.wants = wants
         self.lease: Optional[pb.Lease] = None
+        # Server-sent safe capacity (response field, stored like the
+        # reference sim client, simulation/client.py:197-200 /
+        # :293-296): what the application may consume while it has NO
+        # live lease because of an outage. None until the server ever
+        # sent one; effective only after an outage expiry.
+        self.safe_capacity: Optional[float] = None
+        self._fallback_capacity = 0.0
         self._capacity: asyncio.Queue[float] = asyncio.Queue(
             maxsize=CAPACITY_QUEUE_SIZE
         )
@@ -63,7 +74,9 @@ class ClientResource:
         return self._capacity
 
     def current_capacity(self) -> float:
-        return self.lease.capacity if self.lease is not None else 0.0
+        if self.lease is not None:
+            return self.lease.capacity
+        return self._fallback_capacity
 
     def expires(self) -> float:
         return self.lease.expiry_time if self.lease is not None else 0.0
@@ -217,10 +230,32 @@ class Client:
             if res.lease is not None:
                 rr.has.CopyFrom(res.lease)
 
+        # Each refresh attempt is BOUNDED: the connection's default
+        # retry-forever loop would otherwise never hand control back
+        # during an outage, and a lease could sail past its expiry with
+        # the application never told to fall back to safe capacity. The
+        # bound tightens to the soonest lease expiry so the fallback is
+        # timely, then the next cycle retries (the reference's client
+        # likewise runs discrete periodic attempts, client.go:227-294).
+        now = time.time()
+        soonest = min(
+            (
+                res.expires()
+                for res in self.resources.values()
+                if res.lease is not None
+            ),
+            default=None,
+        )
+        bound = (
+            REFRESH_RPC_BOUND
+            if soonest is None
+            else max(1.0, min(REFRESH_RPC_BOUND, soonest - now))
+        )
         start = time.monotonic()
         try:
-            out = await self.conn.execute(
-                lambda stub: stub.GetCapacity(request),
+            out = await asyncio.wait_for(
+                self.conn.execute(lambda stub: stub.GetCapacity(request)),
+                timeout=bound,
             )
             failed = False
         except Exception:
@@ -236,11 +271,19 @@ class Client:
             now = time.time()
             for res in self.resources.values():
                 if res.lease is not None and res.expires() < now:
-                    # Lease expired during the outage: the application must
-                    # fall back (to safe capacity; 0 here, matching the
-                    # reference's choice at client.go:359-366).
+                    # Lease expired during the outage: fall back to the
+                    # server-sent safe capacity (design.md "safe
+                    # capacity"; reference simulation/client.py:197-200)
+                    # — or to 0 when the server never sent one (the Go
+                    # client's conservative choice, client.go:359-366).
+                    fallback = (
+                        res.safe_capacity
+                        if res.safe_capacity is not None
+                        else 0.0
+                    )
                     res.lease = None
-                    res._push_capacity(0.0)
+                    res._fallback_capacity = fallback
+                    res._push_capacity(fallback)
             return (
                 backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number),
                 retry_number + 1,
@@ -257,8 +300,16 @@ class Client:
             old_capacity = (
                 res.lease.capacity if res.lease is not None else -1.0
             )
+            # Track the per-resource safe capacity exactly as sent:
+            # present -> store, absent -> clear (reference
+            # simulation/client.py:293-296).
+            if pr.HasField("safe_capacity"):
+                res.safe_capacity = pr.safe_capacity
+            else:
+                res.safe_capacity = None
             res.lease = pb.Lease()
             res.lease.CopyFrom(pr.gets)
+            res._fallback_capacity = 0.0  # live lease again
             if res.lease.capacity != old_capacity:
                 res._push_capacity(res.lease.capacity)
 

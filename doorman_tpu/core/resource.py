@@ -62,6 +62,9 @@ class Resource:
             if store_factory is not None
             else LeaseStore(resource_id, clock=clock)
         )
+        # Bound once: the store never changes for a Resource's lifetime,
+        # and the request path should not pay a getattr per decide.
+        self._decide_fast = getattr(self.store, "decide_fast", None)
         self.learning_mode_end = learning_mode_end
         # Expiry of the capacity lease this (intermediate) server holds from
         # its parent; None on the root. Expired parent lease => capacity 0.
@@ -78,6 +81,13 @@ class Resource:
         self.parent_expiry = parent_expiry
         self._algorithm = scalar.get_algorithm(template.algorithm)
         self._learner = scalar.learn(template.algorithm)
+        # Per-request decide parameters, read once per config load:
+        # protobuf field access (and the variant-parameter scan in
+        # algo_kind_for) costs microseconds — too slow to repeat on
+        # every request of the native fast path.
+        self._decide_kind = algo_kind_for(template)
+        self._lease_length = float(template.algorithm.lease_length)
+        self._refresh_interval = float(template.algorithm.refresh_interval)
 
     @property
     def capacity(self) -> float:
@@ -94,7 +104,30 @@ class Resource:
     def decide(self, request: scalar.Request) -> Lease:
         """Per-request (immediate-mode) decision: sweep expired leases then
         run the configured algorithm — or the learner during learning mode
-        (resource.go:100-113)."""
+        (resource.go:100-113). Native stores run the whole decide as one
+        locked C call (sweep + algorithm + upsert, bit-identical grants —
+        native/store.cc::dm_decide); PRIORITY_BANDS and Python stores
+        take the scalar path."""
+        fast = self._decide_fast
+        if fast is not None:
+            kind = (
+                self.store.DECIDE_LEARN
+                if self.in_learning_mode
+                else self._decide_kind
+            )
+            result = fast(
+                kind, self.capacity, self._lease_length,
+                self._refresh_interval, request.has, request.wants,
+                request.subclients, request.priority, request.client,
+            )
+            if result is not None:
+                lease, confused, old_has = result
+                if confused:
+                    scalar.log.error(
+                        "client %s is confused: says it has %s, was "
+                        "assigned %s", request.client, request.has, old_has,
+                    )
+                return lease
         self.store.clean()
         if self.in_learning_mode:
             return self._learner(self.store, self.capacity, request)

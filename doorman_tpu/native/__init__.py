@@ -165,6 +165,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dm_chunk_versions.argtypes = [
         ctypes.c_void_p, _I32P, _I32P, ctypes.c_int64, u64p,
     ]
+    lib.dm_peek.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                            ctypes.c_int64, _F64P]
+    lib.dm_decide.restype = ctypes.c_int32
+    lib.dm_decide.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int32, ctypes.c_int64,
+        _F64P,
+    ]
 
 
 def _load() -> "ctypes.CDLL | None":
@@ -597,7 +606,17 @@ class NativeLeaseStore:
         self._ptr = engine._ptr
         self._rid = rid
         self._clock = engine._clock
-        self._out = np.empty(6, np.float64)  # dm_get scratch
+        # Decide-path scratch with the ctypes pointers prebuilt ONCE:
+        # numpy's data_as() + ctypes.cast() cost ~5us per call — more
+        # than the C call itself. ONLY the decide path (decide_fast /
+        # peek) may use shared scratch: it runs exclusively on the
+        # event loop (RPC handlers and the single-threaded sim). Every
+        # other accessor allocates per call, because the tick executor
+        # thread reads stores concurrently with handlers (len/sums in
+        # the solvers' rebuild checks, get in grant-map rebuilds) and a
+        # shared buffer would tear.
+        self._peek_buf = np.empty(10, np.float64)
+        self._peek_ptr = self._peek_buf.ctypes.data_as(_F64P)
 
     def _sums(self) -> np.ndarray:
         out = np.empty(4, np.float64)
@@ -620,20 +639,82 @@ class NativeLeaseStore:
         return float(self._sums()[1])
 
     def get(self, client: str) -> Lease:
+        # Per-call scratch: get() is also reached from the tick
+        # executor (grant-map rebuilds), concurrent with handlers.
+        out = np.empty(6, np.float64)
         ok = self._lib.dm_get(
             self._ptr, self._rid, self._engine.client_handle(client),
-            self._out.ctypes.data_as(_F64P),
+            out.ctypes.data_as(_F64P),
         )
         if not ok:
             return ZERO_LEASE
-        e, r, h, w, s, p = self._out
+        e, r, h, w, s, p = out
         return Lease(expiry=e, refresh_interval=r, has=h, wants=w,
                      subclients=int(s), priority=int(p))
 
+    def peek(self, client: str):
+        """(found, lease, sum_has, sum_wants, count) in ONE locked C
+        call — the scalar algorithms' whole read set (see
+        algorithms.scalar._peek); absent clients report (False,
+        ZERO_LEASE, ...) with the aggregates still filled."""
+        self._lib.dm_peek(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            self._peek_ptr,
+        )
+        out = self._peek_buf
+        if out[0] == 0.0:
+            return False, ZERO_LEASE, out[7], out[8], int(out[9])
+        lease = Lease(
+            expiry=out[1], refresh_interval=out[2], has=out[3],
+            wants=out[4], subclients=int(out[5]), priority=int(out[6]),
+        )
+        return True, lease, out[7], out[8], int(out[9])
+
+    # dm_decide's LEARN code; 0-4 are AlgoKind lane values (5 is
+    # PRIORITY_BANDS, which never routes to C).
+    DECIDE_LEARN = 6
+    _DECIDE_KINDS = frozenset((0, 1, 2, 3, 4, 6))
+
+    def decide_fast(
+        self,
+        kind: int,
+        capacity: float,
+        lease_length: float,
+        refresh_interval: float,
+        has: float,
+        wants: float,
+        subclients: int,
+        priority: int,
+        client: str,
+    ):
+        """The whole immediate-mode decide (sweep + algorithm + upsert)
+        in one locked C call; grants are bit-identical to the scalar
+        Python oracle (see dm_decide). Returns (Lease, confused,
+        old_has), or None for kinds the C side does not carry (the
+        caller then runs the Python algorithm)."""
+        if kind not in self._DECIDE_KINDS:
+            return None
+        now = self._clock()
+        ok = self._lib.dm_decide(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            kind, capacity, now, lease_length, refresh_interval,
+            has, wants, subclients, priority, self._peek_ptr,
+        )
+        if not ok:
+            return None
+        out = self._peek_buf
+        lease = Lease(
+            expiry=now + lease_length, refresh_interval=refresh_interval,
+            has=float(out[0]), wants=wants, subclients=subclients,
+            priority=priority,
+        )
+        return lease, out[1] != 0.0, float(out[2])
+
     def has_client(self, client: str) -> bool:
+        out = np.empty(6, np.float64)
         return bool(self._lib.dm_get(
             self._ptr, self._rid, self._engine.client_handle(client),
-            self._out.ctypes.data_as(_F64P),
+            out.ctypes.data_as(_F64P),
         ))
 
     def subclients(self, client: str) -> int:

@@ -729,6 +729,181 @@ int32_t dm_get(Engine *e, int32_t rid, int64_t cid, double *out) {
   return 1;
 }
 
+// Request-path combo read: one locked call returns the client's lease
+// AND the resource aggregates — the scalar per-request algorithms need
+// both, and paying a ctypes crossing per field read dominated the
+// immediate-mode serving path. out = {found, expiry, refresh_interval,
+// has, wants, subclients, priority, sum_has, sum_wants, count}; absent
+// clients report found=0 with zeroed lease fields (aggregates still
+// filled).
+void dm_peek(Engine *e, int32_t rid, int64_t cid, double *out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  std::fill(out, out + 10, 0.0);
+  if (!valid_rid(e, rid)) return;
+  const ResourceStore &r = e->resources[rid];
+  out[7] = r.sum_has;
+  out[8] = r.sum_wants;
+  out[9] = static_cast<double>(r.count);
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) return;
+  const Lease &l = r.leases[it->second];
+  out[0] = 1.0;
+  out[1] = l.expiry;
+  out[2] = l.refresh_interval;
+  out[3] = l.has;
+  out[4] = l.wants;
+  out[5] = static_cast<double>(l.subclients);
+  out[6] = static_cast<double>(l.priority);
+}
+
+// Whole per-request decide in ONE locked call: expiry sweep, the
+// scalar algorithm, and the lease upsert — the immediate-mode serving
+// path (reference go/server/doorman/server.go:732-817) without a ctypes
+// crossing per primitive store read. The arithmetic REPLICATES
+// doorman_tpu/algorithms/scalar.py expression-for-expression (including
+// association order), so grants are bit-identical to the Python oracle;
+// the parity test asserts exact equality. `kind`: 0 NO_ALGORITHM,
+// 1 STATIC, 2 PROPORTIONAL_SHARE, 3 FAIR_SHARE, 4 PROPORTIONAL_TOPUP,
+// 6 LEARN (NOT 5 — that is AlgoKind.PRIORITY_BANDS, which must never
+// route here; unknown kinds return 0 and the caller stays on the
+// Python path). out = {gets, confused(FAIR_SHARE has-mismatch),
+// old_has}.
+// Returns 1 (always decides; unknown kinds fall back Python-side and
+// never reach here).
+int32_t dm_decide(Engine *e, int32_t rid, int64_t cid, int32_t kind,
+                  double capacity, double now, double lease_length,
+                  double refresh_interval, double has, double wants,
+                  int32_t subclients, int64_t priority, double *out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  out[0] = out[1] = out[2] = 0.0;
+  if (!valid_rid(e, rid)) return 0;
+  ResourceStore &r = e->resources[rid];
+  if (sweep_resource(e, rid, r, now)) mark_dirty(e, rid);
+
+  auto it = r.index.find(cid);
+  const bool found = it != r.index.end();
+  const Lease old =
+      found ? r.leases[it->second]
+            : Lease{0.0, 0.0, 0.0, 0.0, 0, 0};
+  out[2] = old.has;
+
+  double gets = 0.0;
+  switch (kind) {
+    case 0:  // NO_ALGORITHM: everyone gets what they want.
+      gets = wants;
+      break;
+    case 1:  // STATIC: per-client configured cap.
+      gets = std::min(capacity, wants);
+      break;
+    case 6:  // LEARN: replay the client's reported grant.
+      gets = has;
+      break;
+    case 2: {  // PROPORTIONAL_SHARE (scalar.py:92-104 order).
+      const double all_wants = r.sum_wants - old.wants + wants;
+      const double sum_leases = r.sum_has - old.has;
+      const double free_cap = std::max(capacity - sum_leases, 0.0);
+      if (all_wants < capacity) {
+        gets = std::min(wants, free_cap);
+      } else {
+        gets = std::min(wants * (capacity / all_wants), free_cap);
+      }
+      break;
+    }
+    case 4: {  // PROPORTIONAL_TOPUP (scalar.py:116-158 order).
+      double count = static_cast<double>(r.count);
+      if (!found) count += subclients;
+      const double equal_share = capacity / count;
+      const double equal_share_client = equal_share * subclients;
+      const double unused = capacity - r.sum_has + old.has;
+      if (r.sum_wants <= capacity || wants <= equal_share_client) {
+        gets = std::min(wants, unused);
+        break;
+      }
+      double extra_capacity = 0.0;
+      double extra_need = 0.0;
+      for (size_t j = 0; j < r.leases.size(); ++j) {
+        double w, s;
+        if (r.clients[j] == cid) {
+          w = wants;
+          s = subclients;
+        } else {
+          w = r.leases[j].wants;
+          s = r.leases[j].subclients;
+        }
+        const double share = equal_share * s;
+        if (w < share) {
+          extra_capacity += share - w;
+        } else {
+          extra_need += w - share;
+        }
+      }
+      // An absent requester contributes nothing to the pools — the
+      // Python loop iterates store.items(), substituting the fresh
+      // request only for a slot the requester already holds.
+      gets = equal_share_client +
+             (wants - equal_share_client) * (extra_capacity / extra_need);
+      gets = std::min(gets, unused);
+      break;
+    }
+    case 3: {  // FAIR_SHARE (scalar.py:170-226 order).
+      if (has != old.has) out[1] = 1.0;  // caller logs "confused"
+      const double count =
+          static_cast<double>(r.count) - old.subclients + subclients;
+      const double available = capacity - r.sum_has + old.has;
+      const double equal_share = capacity / count;
+      const double deserved = equal_share * subclients;
+      if (wants <= deserved) {
+        gets = std::min(wants, available);
+        break;
+      }
+      double extra = 0.0;
+      double want_extra = subclients;
+      for (size_t j = 0; j < r.leases.size(); ++j) {
+        if (r.clients[j] == cid) continue;
+        const Lease &l = r.leases[j];
+        const double their_deserved = l.subclients * equal_share;
+        if (l.wants < their_deserved) {
+          extra += their_deserved - l.wants;
+        } else if (l.wants > their_deserved) {
+          want_extra += l.subclients;
+        }
+      }
+      const double deserved_extra = (extra / want_extra) * subclients;
+      if (wants < deserved + deserved_extra) {
+        gets = std::min(wants, available);
+        break;
+      }
+      double extra_extra = 0.0;
+      double want_extra_extra = subclients;
+      for (size_t j = 0; j < r.leases.size(); ++j) {
+        if (r.clients[j] == cid) continue;
+        const Lease &l = r.leases[j];
+        const double their_deserved = l.subclients * equal_share;
+        if (!(l.wants > their_deserved)) continue;  // round-1 subset
+        const double entitled = deserved_extra + deserved;
+        if (l.wants < entitled) {
+          extra_extra += entitled - l.wants;
+        } else if (l.wants > entitled) {
+          want_extra_extra += l.subclients;
+        }
+      }
+      const double deserved_extra_extra =
+          (extra_extra / want_extra_extra) * subclients;
+      gets = std::min(deserved + deserved_extra + deserved_extra_extra,
+                      available);
+      break;
+    }
+    default:
+      return 0;
+  }
+
+  upsert(e, rid, cid,
+         Lease{now + lease_length, refresh_interval, gets, wants,
+               subclients, priority});
+  out[0] = gets;
+  return 1;
+}
+
 // Dump one resource's leases (store order). Arrays must hold
 // dm_sums(...)[3] entries; returns the number written.
 int64_t dm_dump(Engine *e, int32_t rid, int64_t *cids, double *expiry,

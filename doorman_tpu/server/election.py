@@ -157,15 +157,31 @@ class EtcdKV(LeaseKV):
     def _per_request(self, budget: float) -> Callable[[], float]:
         """Per-HTTP-request timeouts drawn from one operation deadline:
         each call gets the remaining budget (capped at REQUEST_TIMEOUT,
-        floored so a nearly-exhausted deadline still issues a fast
+        floored so a nearly-exhausted deadline still issues ONE fast
         request rather than one that cannot succeed at all — the floor
         is sized per endpoint because the gateway splits it across its
-        failover list)."""
+        failover list). After that one floored request the closure
+        RAISES: the caller's wait_for has already abandoned the
+        executor thread by then, and an unbounded floor would let that
+        orphan keep hammering etcd endpoints with doomed requests for
+        the rest of its sequence during a partition."""
         end = time.monotonic() + budget
         floor = 0.1 * len(self._gw.endpoints)
-        return lambda: max(
-            min(self.REQUEST_TIMEOUT, end - time.monotonic()), floor
-        )
+        floored = [False]
+
+        def t() -> float:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                if floored[0]:
+                    raise TimeoutError(
+                        "etcd operation budget exhausted "
+                        f"({budget:.1f}s); abandoning the sequence"
+                    )
+                floored[0] = True
+                return floor
+            return max(min(self.REQUEST_TIMEOUT, remaining), floor)
+
+        return t
 
     async def _call(self, fn, budget: float):
         try:
@@ -279,7 +295,11 @@ class EtcdKV(LeaseKV):
             return False
         # The loss-detection path: sleep(ttl/3) + this operation must
         # conclude well before the lock TTL lapses and a standby wins.
-        budget = min(self.REQUEST_TIMEOUT, ttl / 2.0)
+        # 0.4*ttl, not ttl/2: _call grants budget/4 slack on top, so the
+        # worst case is sleep(ttl/3) + 1.25*budget = ~0.83*ttl — at
+        # small TTLs a ttl/2 budget plus slack consumed nearly the whole
+        # TTL and made elections flappy under minor scheduler delay.
+        budget = min(self.REQUEST_TIMEOUT, 0.4 * ttl)
         t = self._per_request(budget)
 
         def renew() -> bool:

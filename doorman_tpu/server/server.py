@@ -261,14 +261,15 @@ class CapacityServer(CapacityServicer):
         config_mod.validate_repository(repo)
         if repo.groups and self.mode != "batch":
             # Shared upstream caps are enforced only by the batched
-            # priority solve; accepting them in immediate mode would
-            # silently overcommit the grouped resources.
-            log.warning(
-                "config defines %d capacity group(s) but server mode is "
-                "%r: group caps are enforced only in batch mode and will "
-                "NOT be applied",
-                len(repo.groups),
-                self.mode,
+            # priority solve; a config that validates and then is not
+            # enforced would silently overcommit the grouped resources
+            # (an operator trap), so reject it outright. Hot-reload
+            # callers catch this and keep the last good config.
+            raise config_mod.ConfigError(
+                f"config defines {len(repo.groups)} capacity group(s) "
+                f"but server mode is {self.mode!r}: group caps are "
+                "enforced only by the batch tick — run the server in "
+                "batch mode or remove the groups"
             )
         first_time = self.config is None
         self.config = repo

@@ -56,7 +56,18 @@ def wait_for_backend(
             # incidental import warnings earlier in the tail must not
             # abort the blip-riding retries.
             last_line = reason.splitlines()[-1] if reason else ""
-            if last_line.startswith(("ModuleNotFoundError", "ImportError")):
+            # Code/environment breakage can never heal by waiting.
+            # ONLY error types that transport failures never raise are
+            # classified unretryable: RuntimeError/ValueError stay
+            # retryable because a down tunnel surfaces exactly those
+            # (fast and verbatim-identical), and burning the paced
+            # schedule on them would recreate the round-4 failure mode
+            # (a multi-hour outage reported as unreachable seconds in,
+            # when spanning the blip was the whole point).
+            if last_line.startswith(
+                ("ModuleNotFoundError", "ImportError", "SyntaxError",
+                 "AttributeError", "NameError")
+            ):
                 print(
                     f"backend probe failed (unretryable): {last_line}",
                     file=sys.stderr, flush=True,

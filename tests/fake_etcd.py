@@ -153,6 +153,16 @@ class FakeEtcd:
             }
         if path == "/v3/lease/revoke":
             lease_id = int(body["ID"])
+            with self._lock:
+                self._sweep()
+                known = lease_id in self._leases
+            if not known:
+                # Real etcd errors on revoking an unknown/expired lease
+                # (HTTP 400, "etcdserver: requested lease not found");
+                # the election's _revoke_quietly treats that as
+                # "unconfirmed" and keeps its backstop armed — a fake
+                # that 200s here would hide that path.
+                raise ValueError("etcdserver: requested lease not found")
             self.expire_lease(lease_id)
             return {}
         if path == "/v3/watch":

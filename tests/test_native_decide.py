@@ -1,0 +1,136 @@
+"""dm_decide (the one-call C request path) vs the scalar Python oracle.
+
+The C decide replicates algorithms/scalar.py expression-for-expression,
+so on IDENTICAL store states its grants must be BIT-identical — the
+comparison runs two native engines through the same request stream, one
+deciding in C (Resource.decide fast path), one through the Python
+algorithm closures, and asserts exact equality per request and over the
+final stores. (Native-vs-Python-STORE comparisons cannot be bit-exact:
+the two stores accumulate their running sums in different removal
+orders.)"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.algorithms import scalar
+from doorman_tpu.core.resource import Resource
+from doorman_tpu.proto import doorman_pb2 as pb
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+CASES = [
+    (pb.Algorithm.NO_ALGORITHM, None),
+    (pb.Algorithm.STATIC, None),
+    (pb.Algorithm.PROPORTIONAL_SHARE, None),
+    (pb.Algorithm.PROPORTIONAL_SHARE, "topup"),
+    (pb.Algorithm.FAIR_SHARE, None),
+]
+
+
+def make_template(kind, variant):
+    algo = pb.Algorithm(kind=kind, lease_length=60, refresh_interval=5)
+    if variant:
+        p = algo.parameters.add()
+        p.name = "variant"
+        p.value = variant
+    return pb.ResourceTemplate(
+        identifier_glob="r", capacity=500.0, algorithm=algo
+    )
+
+
+@pytest.mark.parametrize("kind,variant", CASES)
+def test_c_decide_bit_identical_to_scalar_oracle(kind, variant):
+    rng = np.random.default_rng(int(kind) * 7 + 1)
+    t = [1000.0]
+    clock = lambda: t[0]
+    tpl = make_template(kind, variant)
+    eng_a = native.StoreEngine(clock=clock)
+    eng_b = native.StoreEngine(clock=clock)
+    ra = Resource("r", tpl, clock=clock, store_factory=eng_a.store)
+    rb = Resource("r", tpl, clock=clock, store_factory=eng_b.store)
+    assert ra._decide_fast is not None  # the C path is actually on
+    pyalgo = scalar.get_algorithm(tpl.algorithm)
+    grants_a, grants_b = {}, {}
+    for i in range(2500):
+        c = f"c{rng.integers(0, 40)}"
+        wants = float(rng.integers(1, 200))
+        sub = int(rng.integers(1, 4))
+        la = ra.decide(scalar.Request(c, grants_a.get(c, 0.0), wants, sub))
+        rb.store.clean()
+        lb = pyalgo(
+            rb.store, rb.capacity,
+            scalar.Request(c, grants_b.get(c, 0.0), wants, sub),
+        )
+        assert la.has == lb.has, (i, c, la.has, lb.has)
+        assert la.expiry == lb.expiry and la.wants == lb.wants
+        grants_a[c], grants_b[c] = la.has, lb.has
+        if rng.random() < 0.05:
+            ra.store.release(c)
+            rb.store.release(c)
+            grants_a.pop(c, None)
+            grants_b.pop(c, None)
+        if rng.random() < 0.02:
+            t[0] += float(rng.integers(1, 80))  # expiry sweeps
+    a = dict(ra.store.items())
+    b = dict(rb.store.items())
+    assert a.keys() == b.keys()
+    for k in a:
+        assert a[k].has == b[k].has and a[k].wants == b[k].wants
+
+
+def test_learning_mode_routes_to_c_learn():
+    t = [1000.0]
+    clock = lambda: t[0]
+    tpl = make_template(pb.Algorithm.PROPORTIONAL_SHARE, None)
+    eng = native.StoreEngine(clock=clock)
+    res = Resource(
+        "r", tpl, clock=clock, learning_mode_end=t[0] + 100,
+        store_factory=eng.store,
+    )
+    lease = res.decide(scalar.Request("x", 42.0, 50.0, 1))
+    assert lease.has == 42.0  # learning replays the reported grant
+    t[0] += 200  # learning window over: real algorithm resumes
+    lease = res.decide(scalar.Request("x", lease.has, 50.0, 1))
+    assert lease.has == 50.0  # only client, fits capacity
+
+
+def test_priority_bands_stays_on_python_path():
+    """AlgoKind.PRIORITY_BANDS (5) must never reach dm_decide (whose
+    LEARN code is 6 precisely to avoid the collision)."""
+    t = [1000.0]
+    clock = lambda: t[0]
+    algo = pb.Algorithm(
+        kind=pb.Algorithm.PRIORITY_BANDS, lease_length=60,
+        refresh_interval=5,
+    )
+    tpl = pb.ResourceTemplate(
+        identifier_glob="r", capacity=100.0, algorithm=algo
+    )
+    eng = native.StoreEngine(clock=clock)
+    res = Resource("r", tpl, clock=clock, store_factory=eng.store)
+    lease = res.decide(scalar.Request("a", 0.0, 80.0, 1, priority=3))
+    assert lease.has == 80.0
+    # The banded scalar path (not C) decided: a higher-priority claim
+    # displaces on the next round, the C lanes have no such behavior.
+    lease_b = res.decide(scalar.Request("b", 0.0, 100.0, 1, priority=9))
+    assert lease_b.has == 20.0
+
+
+def test_expiry_sweep_inside_c_decide():
+    t = [1000.0]
+    clock = lambda: t[0]
+    tpl = make_template(pb.Algorithm.PROPORTIONAL_SHARE, None)
+    eng = native.StoreEngine(clock=clock)
+    res = Resource("r", tpl, clock=clock, store_factory=eng.store)
+    res.decide(scalar.Request("dead", 0.0, 400.0, 1))
+    t[0] += 120  # past the 60s lease
+    lease = res.decide(scalar.Request("live", 0.0, 400.0, 1))
+    # The dead lease was swept inside the same C call, so the whole
+    # capacity is free for the new client.
+    assert lease.has == 400.0
+    assert not res.store.has_client("dead")

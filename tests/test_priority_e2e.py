@@ -37,6 +37,26 @@ resources:
 """
 
 
+# Group-free variant: immediate-mode servers REJECT grouped configs
+# (group caps are enforced only by the batch tick), so the scalar
+# band tests run without them.
+NOGROUP_YAML = """
+resources:
+  - identifier_glob: "prio-*"
+    capacity: 100
+    algorithm:
+      kind: PRIORITY_BANDS
+      lease_length: 60
+      refresh_interval: 5
+  - identifier_glob: "*"
+    capacity: 100
+    algorithm:
+      kind: PROPORTIONAL_SHARE
+      lease_length: 60
+      refresh_interval: 5
+"""
+
+
 class FakeClock:
     def __init__(self, t=1000.0):
         self.t = t
@@ -87,9 +107,33 @@ def _make_server(clock, mode="immediate", native=False):
 
 
 async def _setup(server, clock):
-    await server.load_config(config_mod.parse_yaml_config(BASE_YAML))
+    yaml = BASE_YAML if server.mode == "batch" else NOGROUP_YAML
+    await server.load_config(config_mod.parse_yaml_config(yaml))
     await server._on_is_master(True)
     server.became_master_at = clock() - 10_000  # skip learning mode
+
+
+def test_grouped_config_rejected_outside_batch_mode():
+    """Group caps are enforced only by the batch tick; accepting a
+    grouped config on an immediate server would validate-then-ignore it
+    (silent overcommit), so load_config must reject instead."""
+
+    async def scenario():
+        clock = FakeClock()
+        server = _make_server(clock, mode="immediate")
+        with pytest.raises(ConfigError, match="capacity group"):
+            await server.load_config(
+                config_mod.parse_yaml_config(BASE_YAML)
+            )
+        # The server keeps running and accepts a group-free config.
+        await server.load_config(
+            config_mod.parse_yaml_config(NOGROUP_YAML)
+        )
+        # A batch server accepts the same grouped config.
+        batch = _make_server(clock, mode="batch")
+        await batch.load_config(config_mod.parse_yaml_config(BASE_YAML))
+
+    asyncio.run(scenario())
 
 
 def test_immediate_mode_priority_bands():

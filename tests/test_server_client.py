@@ -4,6 +4,7 @@ mode, release, config hot-swap, GetServerCapacity validation, the client
 refresh loop, and the batch (TPU-tick) serving mode."""
 
 import asyncio
+import time
 
 import grpc
 import pytest
@@ -378,6 +379,83 @@ def test_client_refresh_loop():
             )
             await client.close()
         finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_outage_expiry_falls_back_to_safe_capacity():
+    """A lease expiring during a server outage falls back to the
+    SERVER-SENT safe capacity (design.md semantics; reference
+    simulation/client.py:197-200), not to 0 — and the QPS limiter
+    throttles to that fallback rate. 0 remains the fallback only when
+    the server never sent a safe capacity."""
+
+    async def body():
+        from doorman_tpu.ratelimiter import new_qps
+
+        server, addr = await make_server()
+        client = await Client.connect(
+            addr, "safecap-client", minimum_refresh_interval=0.05
+        )
+        try:
+            res = await client.resource("proportional", 30.0)
+            capacity = await asyncio.wait_for(res.capacity().get(), timeout=5)
+            assert capacity == 30.0
+            # The config's safe_capacity rode the response in.
+            assert res.safe_capacity == 2.0
+            limiter = new_qps(res)
+            await asyncio.sleep(0.1)  # limiter consumes the 30.0 update
+
+            # Outage: server down, lease forced past expiry.
+            await server.stop()
+            res.lease.expiry_time = 1
+            deadline = time.monotonic() + 5.0
+            while res.lease is not None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert res.lease is None, "lease not expired during outage"
+            assert res.current_capacity() == 2.0
+            # The limiter now meters at the safe rate: 2 QPS -> one
+            # release per 500ms; three waits take >= ~1s, not instant.
+            t0 = time.monotonic()
+            for _ in range(3):
+                await asyncio.wait_for(limiter.wait(), timeout=5)
+            assert time.monotonic() - t0 > 0.8, "limiter not throttled"
+            await limiter.close()
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_outage_expiry_without_safe_capacity_pushes_zero():
+    """The '*' template has no safe_capacity: the server sends a
+    dynamic fallback (capacity / clients) — the client must use what
+    the server sent; clearing the field server-side would mean 0."""
+
+    async def body():
+        server, addr = await make_server()
+        client = await Client.connect(
+            addr, "nocap-client", minimum_refresh_interval=0.05
+        )
+        try:
+            res = await client.resource("other", 10.0)
+            await asyncio.wait_for(res.capacity().get(), timeout=5)
+            # Dynamic safe capacity: capacity 120 / 1 client.
+            assert res.safe_capacity == 120.0
+            # Simulate "server never sent one" (old servers / cleared
+            # field): the conservative 0 fallback applies.
+            res.safe_capacity = None
+            await server.stop()
+            res.lease.expiry_time = 1
+            deadline = time.monotonic() + 5.0
+            while res.lease is not None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert res.lease is None
+            assert res.current_capacity() == 0.0
+        finally:
+            await client.close()
             await server.stop()
 
     run(body())

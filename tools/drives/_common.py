@@ -40,13 +40,18 @@ def pin_platform_in_process() -> None:
 
 def spawn(args, name="proc"):
     """Start a child with stdout+stderr appended to a temp log file
-    (returned alongside, for tailing on failure)."""
+    (returned alongside, for tailing on failure). The parent closes its
+    handle right after Popen — the child holds its own fd, and a drive
+    spawning many children must not leak one fd per child."""
     log = tempfile.NamedTemporaryFile(
         "w+", suffix=f".{name}.log", delete=False
     )
-    proc = subprocess.Popen(
-        args, cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True
-    )
+    try:
+        proc = subprocess.Popen(
+            args, cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True
+        )
+    finally:
+        log.close()
     proc._drive_log = log.name  # type: ignore[attr-defined]
     return proc
 
@@ -60,6 +65,11 @@ def tail(proc, n=2000) -> str:
 
 
 def stop(proc) -> None:
+    """Terminate a spawned child. The log is deleted only on a CLEAN
+    exit (rc 0 or our own terminate signal): a drive that notices a
+    failure after tearing its servers down in a finally block still has
+    the child log to tail."""
+    already_failed = proc.poll() is not None and proc.returncode not in (0,)
     proc.terminate()
     try:
         proc.wait(5)
@@ -67,8 +77,15 @@ def stop(proc) -> None:
         proc.kill()
         proc.wait()
     path = getattr(proc, "_drive_log", None)
-    if path and os.path.exists(path):
+    if not path or not os.path.exists(path):
+        return
+    # -15/-9 are OUR terminate/kill above — those are clean teardowns.
+    clean = not already_failed and proc.returncode in (0, -15, -9)
+    if clean:
         os.unlink(path)
+    else:
+        print(f"kept child log (rc={proc.returncode}): {path}",
+              flush=True)
 
 
 def write_config(body: str) -> str:

@@ -22,6 +22,7 @@ QUICK = [
     ("drive_flip.py", 420),
     ("drive_priority.py", 420),
     ("drive_tree.py", 480),
+    ("drive_tree3.py", 480),
     ("drive_loadtest.py", 480),
     # Scales with the platform: 50k wide clients on cpu, 1M on device.
     ("drive_wide.py", 900),
