@@ -16,9 +16,11 @@ from doorman_tpu.parallel.multihost import (  # noqa: F401
     pack_process_edges,
 )
 from doorman_tpu.parallel.sharded import (  # noqa: F401
+    make_sharded_chunked_solver,
     make_sharded_dense_solver,
     make_sharded_priority_solver,
     make_sharded_solver,
+    shard_chunked,
     shard_dense,
     shard_edges,
     shard_priority,

@@ -144,6 +144,83 @@ def make_sharded_dense_solver(mesh: Mesh, *, donate: bool = False):
     return solve
 
 
+def make_sharded_chunked_solver(mesh: Mesh, *, donate: bool = False):
+    """Chunk-row sharded WIDE-resource solve: the chunked layout
+    (solver.dense.ChunkedDenseBatch — a resource spans consecutive
+    [row, K] chunks) with the row axis sharded over every mesh axis.
+    Unlike the narrow dense solve, a wide resource's chunks SPAN
+    devices, so per-segment totals are the two-level reduction's local
+    half (row reduction + local sorted segment_sum) combined with one
+    [S]-sized psum over ICI — the same aggregation the host-side server
+    tree performs, fused on-chip. This is the scale-out story for
+    doorman's headline shape: one shared resource with more clients
+    than one chip comfortably holds. Place inputs with
+    `shard_chunked`."""
+    from doorman_tpu.solver.dense import chunked_reduces
+    from doorman_tpu.solver.lanes import solve_lanes
+
+    axes = tuple(mesh.axis_names)
+    row = P(axes)
+    rowk = P(axes, None)
+    rep = P()
+
+    def shard_fn(wants, has, sub, active, row_seg, cap, kind, learning,
+                 static_cap):
+        local_sum, local_max = chunked_reduces(row_seg, cap.shape[0])
+        return solve_lanes(
+            wants, has, sub, active, cap, kind, learning, static_cap,
+            segsum=_psum_reduce(local_sum, axes),
+            segmax=_psum_max(local_max, axes),
+            expand=lambda totals: totals[row_seg][:, None],
+        )
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(rowk, rowk, rowk, rowk, row, rep, rep, rep, rep),
+        out_specs=rowk,
+    )
+
+    @partial(jax.jit, donate_argnums=tuple(range(4)) if donate else ())
+    def solve_parts(wants, has, subclients, active, row_seg, capacity,
+                    algo_kind, learning, static_capacity):
+        return mapped(
+            wants, has, subclients, active, row_seg,
+            capacity, algo_kind, learning, static_capacity,
+        )
+
+    def solve(batch) -> jax.Array:
+        return solve_parts(
+            batch.wants, batch.has, batch.subclients, batch.active,
+            batch.row_seg, batch.capacity, batch.algo_kind,
+            batch.learning, batch.static_capacity,
+        )
+
+    return solve
+
+
+def shard_chunked(mesh: Mesh, batch):
+    """Place a ChunkedDenseBatch on the mesh: chunk rows (and row_seg)
+    sharded over all mesh axes, padded with inactive rows mapped to the
+    LAST segment (the caller's padding segment) so per-shard row_seg
+    stays sorted; the per-segment config arrays are replicated."""
+    from doorman_tpu.solver.dense import ChunkedDenseBatch
+
+    put = _row_placer(mesh, int(np.asarray(batch.row_seg).shape[0]))
+    pad_seg = int(np.asarray(batch.capacity).shape[0]) - 1
+    return ChunkedDenseBatch(
+        wants=put(batch.wants),
+        has=put(batch.has),
+        subclients=put(batch.subclients),
+        active=put(batch.active),
+        row_seg=put(batch.row_seg, fill=pad_seg),
+        capacity=put(batch.capacity, sharded_rows=False),
+        algo_kind=put(batch.algo_kind, sharded_rows=False),
+        learning=put(batch.learning, sharded_rows=False),
+        static_capacity=put(batch.static_capacity, sharded_rows=False),
+    )
+
+
 def make_sharded_priority_solver(
     mesh: Mesh, num_bands: int = 4, *, donate: bool = False
 ):
@@ -195,9 +272,11 @@ def make_sharded_priority_solver(
 
 def _row_placer(mesh: Mesh, num_rows: int):
     """Shared pad-and-place machinery for the row-sharded batch layouts
-    (shard_dense / shard_priority): rows pad up to a multiple of the
-    device count with `fill`, then land sharded over all mesh axes
-    (spec P(axes, ...) per trailing rank) or replicated (spec=None)."""
+    (shard_dense / shard_priority / shard_chunked): rows pad up to a
+    multiple of the device count with `fill` — shard_chunked relies on
+    fill=pad_seg keeping row_seg sorted — then land sharded over all
+    mesh axes (spec P(axes, ...) per trailing rank) or replicated
+    (spec=None)."""
     n_dev = int(np.prod(list(mesh.shape.values())))
     pad = (-num_rows) % n_dev
     axes = tuple(mesh.axis_names)

@@ -93,23 +93,37 @@ class ChunkedDenseBatch:
     static_capacity: jax.Array  # [S]
 
 
+def chunked_reduces(row_seg: jax.Array, num_segments: int):
+    """The LOCAL halves of the two-level chunk reduction (row reduction
+    + sorted segment op over row totals), shared by the single-device
+    solve below and the mesh-sharded wrap in parallel/sharded.py (which
+    combines them with psum/pmax) — one implementation, so the sharded
+    path cannot silently diverge from the single-chip oracle. Rows are
+    resource-major (row_seg sorted; shard slices stay sorted). Empty
+    segments produce the dtype minimum from segment_max; solve_lanes
+    already guards its one segmax use (max_ratio) against non-finite."""
+
+    def segsum(v):
+        return jax.ops.segment_sum(
+            v.sum(axis=1), row_seg, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    def segmax(v):
+        return jax.ops.segment_max(
+            v.max(axis=1), row_seg, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    return segsum, segmax
+
+
 def solve_chunked(batch: ChunkedDenseBatch) -> jax.Array:
     """Grants [R, K]; identical lane semantics — only the reductions
     differ (two-level instead of one row reduction)."""
     seg = batch.row_seg
     S = batch.capacity.shape[0]
-
-    def segsum(v):
-        return jax.ops.segment_sum(
-            v.sum(axis=1), seg, num_segments=S, indices_are_sorted=True
-        )
-
-    def segmax(v):
-        # Empty segments produce the dtype minimum; solve_lanes already
-        # guards its one segmax use (max_ratio) against non-finite.
-        return jax.ops.segment_max(
-            v.max(axis=1), seg, num_segments=S, indices_are_sorted=True
-        )
+    segsum, segmax = chunked_reduces(seg, S)
 
     return solve_lanes(
         batch.wants,
