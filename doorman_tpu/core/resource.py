@@ -63,8 +63,9 @@ class Resource:
             else LeaseStore(resource_id, clock=clock)
         )
         # Bound once: the store never changes for a Resource's lifetime,
-        # and the request path should not pay a getattr per decide.
+        # and the request paths should not pay a getattr per request.
         self._decide_fast = getattr(self.store, "decide_fast", None)
+        self._refresh_grant = getattr(self.store, "refresh_grant", None)
         self.learning_mode_end = learning_mode_end
         # Expiry of the capacity lease this (intermediate) server holds from
         # its parent; None on the root. Expired parent lease => capacity 0.

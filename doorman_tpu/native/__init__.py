@@ -174,6 +174,12 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_double, ctypes.c_double, ctypes.c_int32, ctypes.c_int64,
         _F64P,
     ]
+    lib.dm_refresh_grant.restype = ctypes.c_int32
+    lib.dm_refresh_grant.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int32, ctypes.c_int64,
+        _F64P,
+    ]
 
 
 def _load() -> "ctypes.CDLL | None":
@@ -606,15 +612,16 @@ class NativeLeaseStore:
         self._ptr = engine._ptr
         self._rid = rid
         self._clock = engine._clock
-        # Decide-path scratch with the ctypes pointers prebuilt ONCE:
+        # Request-path scratch with the ctypes pointers prebuilt ONCE:
         # numpy's data_as() + ctypes.cast() cost ~5us per call — more
-        # than the C call itself. ONLY the decide path (decide_fast /
-        # peek) may use shared scratch: it runs exclusively on the
-        # event loop (RPC handlers and the single-threaded sim). Every
-        # other accessor allocates per call, because the tick executor
-        # thread reads stores concurrently with handlers (len/sums in
-        # the solvers' rebuild checks, get in grant-map rebuilds) and a
-        # shared buffer would tear.
+        # than the C call itself. ONLY the request paths (decide_fast /
+        # peek / refresh_grant) may use shared scratch: they run
+        # exclusively on the event loop (RPC handlers and the
+        # single-threaded sim). Every other accessor allocates per
+        # call, because the tick executor thread reads stores
+        # concurrently with handlers (len/sums in the solvers' rebuild
+        # checks, get in grant-map rebuilds) and a shared buffer would
+        # tear.
         self._peek_buf = np.empty(10, np.float64)
         self._peek_ptr = self._peek_buf.ctypes.data_as(_F64P)
 
@@ -709,6 +716,34 @@ class NativeLeaseStore:
             priority=priority,
         )
         return lease, out[1] != 0.0, float(out[2])
+
+    def refresh_grant(
+        self,
+        client: str,
+        lease_length: float,
+        refresh_interval: float,
+        wants: float,
+        subclients: int,
+        priority: int,
+    ) -> "Lease | None":
+        """Batch-mode request path in one locked C call: record new
+        demand + fresh expiry, PRESERVE the granted has (the tick
+        recomputes; see dm_refresh_grant). Returns the refreshed lease,
+        or None when the client holds no lease (the caller then runs
+        the decide path, which admits new clients)."""
+        expiry = self._clock() + lease_length
+        ok = self._lib.dm_refresh_grant(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            expiry, refresh_interval, wants, subclients, priority,
+            self._peek_ptr,  # event-loop-only scratch, like decide_fast
+        )
+        if not ok:
+            return None
+        return Lease(
+            expiry=expiry, refresh_interval=refresh_interval,
+            has=float(self._peek_buf[0]), wants=wants,
+            subclients=subclients, priority=priority,
+        )
 
     def has_client(self, client: str) -> bool:
         out = np.empty(6, np.float64)

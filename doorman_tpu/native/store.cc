@@ -756,6 +756,30 @@ void dm_peek(Engine *e, int32_t rid, int64_t cid, double *out) {
   out[6] = static_cast<double>(l.priority);
 }
 
+// Batch-mode request path in ONE locked call: if the client holds a
+// lease, record its new demand (wants/subclients/priority) and stamp a
+// fresh expiry while PRESERVING the granted has — a batch server
+// serves the last tick's solved grant and only notes demand; the tick
+// recomputes (server.py _decide). Writes the served has to *has_out
+// and returns 1; returns 0 when the client is unknown (the caller
+// falls to the decide path, which admits new clients).
+int32_t dm_refresh_grant(Engine *e, int32_t rid, int64_t cid,
+                         double expiry, double refresh_interval,
+                         double wants, int32_t subclients,
+                         int64_t priority, double *has_out) {
+  std::lock_guard<std::mutex> lock(e->mu);
+  if (!valid_rid(e, rid)) return 0;
+  ResourceStore &r = e->resources[rid];
+  auto it = r.index.find(cid);
+  if (it == r.index.end()) return 0;
+  const double has = r.leases[it->second].has;
+  upsert(e, rid, cid,
+         Lease{expiry, refresh_interval, has, wants, subclients,
+               priority});
+  *has_out = has;
+  return 1;
+}
+
 // Whole per-request decide in ONE locked call: expiry sweep, the
 // scalar algorithm, and the lease upsert — the immediate-mode serving
 // path (reference go/server/doorman/server.go:732-817) without a ctypes

@@ -849,19 +849,30 @@ class CapacityServer(CapacityServicer):
             self.mode == "batch"
             and not res.in_learning_mode
             and self._ticks_done > 0
-            and res.store.has_client(request.client)
         ):
-            algo = res.template.algorithm
-            lease = res.store.assign(
-                request.client,
-                float(algo.lease_length),
-                float(algo.refresh_interval),
-                res.store.get(request.client).has,
-                request.wants,
-                request.subclients,
-                priority=request.priority,
-            )
-            return lease, res
+            rg = res._refresh_grant
+            if rg is not None:
+                # Native store: one locked C call records the demand
+                # and serves the last solved grant (dm_refresh_grant);
+                # None means the client is new — fall to decide below.
+                lease = rg(
+                    request.client, res._lease_length,
+                    res._refresh_interval, request.wants,
+                    request.subclients, request.priority,
+                )
+                if lease is not None:
+                    return lease, res
+            elif res.store.has_client(request.client):
+                lease = res.store.assign(
+                    request.client,
+                    res._lease_length,
+                    res._refresh_interval,
+                    res.store.get(request.client).has,
+                    request.wants,
+                    request.subclients,
+                    priority=request.priority,
+                )
+                return lease, res
         return res.decide(request), res
 
     # ------------------------------------------------------------------

@@ -134,3 +134,33 @@ def test_expiry_sweep_inside_c_decide():
     # capacity is free for the new client.
     assert lease.has == 400.0
     assert not res.store.has_client("dead")
+
+
+def test_refresh_grant_preserves_has_and_marks_demand():
+    """The batch-mode one-call path: has preserved (the tick is the
+    only writer of grants), demand recorded, expiry stamped; unknown
+    clients return None; wants-only churn marks the slot wants-dirty
+    while a subclient change marks it full."""
+    t = [1000.0]
+    clock = lambda: t[0]
+    eng = native.StoreEngine(clock=clock)
+    st = eng.store("r")
+    st.assign("c", 60.0, 5.0, 7.5, 10.0, 1)
+    eng.chunk_config(np.array([st._rid], np.int32), 8)
+
+    lease = st.refresh_grant("c", 60.0, 5.0, 42.0, 1, 0)
+    assert lease is not None
+    assert lease.has == 7.5 and lease.wants == 42.0
+    assert lease.expiry == t[0] + 60.0
+    got = st.get("c")
+    assert got.has == 7.5 and got.wants == 42.0
+    slots, lvl = eng.drain_slots(st._rid)
+    assert list(slots) == [0] and list(lvl) == [1]  # wants-only
+
+    # Subclient change -> full-dirty slot.
+    st.refresh_grant("c", 60.0, 5.0, 42.0, 3, 0)
+    slots, lvl = eng.drain_slots(st._rid)
+    assert list(slots) == [0] and list(lvl) == [2]
+    assert st.count == 3
+
+    assert st.refresh_grant("nobody", 60.0, 5.0, 1.0, 1, 0) is None
