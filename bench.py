@@ -86,6 +86,24 @@ UPLOAD_LOOKAHEAD = 2  # ticks of demand churn staged ahead of the solve
 RUNS = 5  # best-of: the tunnel link is shared and bursty
 
 
+def phase_attribution(solver, phase_mark, collects_mark, n_ticks):
+    """Per-phase ms/tick over the measured window, shared by the narrow
+    and wide server-tick benches: dispatch phases divide by the ticks
+    DISPATCHED in the window, collect phases (download/apply) by the
+    collects that actually landed in it (pipelining shifts a few
+    warmup collects past the snapshot)."""
+    n_collects = max(solver.ticks - collects_mark, 1)
+    collect_phases = ("download", "apply")
+    return {
+        k: round(
+            (v - phase_mark.get(k, 0.0)) * 1000.0
+            / (n_collects if k in collect_phases else n_ticks),
+            3,
+        )
+        for k, v in solver.phase_s.items()
+    }
+
+
 def spot_check(wants, has, active, capacity, kind, static_cap, gets):
     """Validate a handful of resources against the numpy oracles."""
     from doorman_tpu.algorithms.tick import oracle_row
@@ -414,23 +432,14 @@ def bench_server_tick() -> None:
         t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
     )
     med = float(np.median(timed))
-    # Per-phase attribution over the measured window (ms per tick):
-    # dispatch = sweep + drain + pack + config + upload + launch;
-    # collect = download + apply; churn is the client-write workload
-    # applied between ticks (included in the headline number because
-    # the reference's per-request decide pays it inline too). Collect
-    # phases divide by the collects actually in the window (pipelining
-    # shifts a few warmup collects past the snapshot).
-    n_collects = max(solver.ticks - collects_mark, 1)
-    collect_phases = ("download", "apply")
-    phases = {
-        k: round(
-            (v - phase_mark.get(k, 0.0)) * 1000.0
-            / (n_collects if k in collect_phases else TICKS_SERVER),
-            3,
-        )
-        for k, v in solver.phase_s.items()
-    }
+    # Per-phase attribution (phase_attribution): dispatch = sweep +
+    # drain + pack + config + upload + launch; collect = download +
+    # apply; churn is the client-write workload applied between ticks
+    # (included in the headline number because the reference's
+    # per-request decide pays it inline too).
+    phases = phase_attribution(
+        solver, phase_mark, collects_mark, TICKS_SERVER
+    )
     phases["churn"] = round(
         float(np.mean(churn_ms[SERVER_WARMUP:])), 3
     )
@@ -551,7 +560,12 @@ def bench_server_tick_wide() -> None:
 
         tick_ms = []
         handles = []
+        phase_mark = {}
+        collects_mark = 0
         for t in range(n_ticks):
+            if t == SERVER_WARMUP:
+                phase_mark = dict(solver.phase_s)
+                collects_mark = solver.ticks
             t0 = time.perf_counter()
             edge = churn_edges[t]
             engine.bulk_refresh(
@@ -571,6 +585,9 @@ def bench_server_tick_wide() -> None:
             t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
         )
         med = float(np.median(timed))
+        phases = phase_attribution(
+            solver, phase_mark, collects_mark, TICKS_WIDE
+        )
         emit(
             {
                 "metric": f"server_tick_wide_{label}_wall_ms",
@@ -584,6 +601,7 @@ def bench_server_tick_wide() -> None:
                 "p99_ms": round(float(np.percentile(timed, 99)), 3),
                 "chunk_rows": solver._R,
                 "rotate_ticks": SERVER_ROTATE_TICKS,
+                "phase_ms": phases,
             }
         )
 

@@ -141,12 +141,14 @@ class EtcdKV(LeaseKV):
     # inside KVElection's renewal cadence (ttl/3 with ttl defaulting to
     # 10s), not the gateway's lenient config-watch default — so each
     # OPERATION also gets an overall budget, sized to the number of
-    # sequential RPCs it issues (refresh: min(this, ttl/2); acquire,
-    # which is not on the loss-detection path, gets 3x for its
-    # get + lease-grant + transactional-put sequence). Budgeting each
-    # request off the operation's shared deadline keeps the sum inside
-    # the budget instead of stacking per-request timeouts past the lock
-    # TTL and re-opening the split-brain window.
+    # sequential RPCs it issues (refresh: a 0.5*ttl window covering a
+    # 0.32*ttl first attempt plus one transient-failure retry, see
+    # refresh(); acquire, which is not on the loss-detection path, gets
+    # 3x REQUEST_TIMEOUT for its get + lease-grant + transactional-put
+    # sequence). Budgeting each request off the operation's shared
+    # deadline keeps the sum inside the budget instead of stacking
+    # per-request timeouts past the lock TTL and re-opening the
+    # split-brain window.
     REQUEST_TIMEOUT = 5.0
 
     def __init__(self, endpoints: list[str]):
@@ -294,44 +296,64 @@ class EtcdKV(LeaseKV):
         if lease_id is None:
             return False
         # The loss-detection path: sleep(ttl/3) + this operation must
-        # conclude well before the lock TTL lapses and a standby wins.
-        # 0.4*ttl, not ttl/2: _call grants budget/4 slack on top, so the
-        # worst case is sleep(ttl/3) + 1.25*budget = ~0.83*ttl — at
-        # small TTLs a ttl/2 budget plus slack consumed nearly the whole
-        # TTL and made elections flappy under minor scheduler delay.
-        budget = min(self.REQUEST_TIMEOUT, 0.4 * ttl)
-        t = self._per_request(budget)
+        # conclude well before the lock TTL lapses and a standby wins;
+        # the WHOLE operation (slack included) fits a 0.5*ttl window so
+        # the worst case stays ~0.83*ttl. Within that window a single
+        # TRANSIENT failure — an executor thread starved by a
+        # concurrent XLA compile, one dropped etcd round-trip — retries
+        # instead of reading as mastership loss (small-TTL elections
+        # flapped under load without this). The FIRST attempt gets the
+        # lion's share (0.32*ttl, +_call's budget/4 slack = 0.4*ttl —
+        # the previous single-attempt tolerance, so a slow-but-healthy
+        # etcd still succeeds first try); the retry runs in whatever
+        # window remains, which is nearly everything when the first
+        # attempt failed fast. DEFINITE losses (lease TTL 0, key not
+        # ours) never retry.
+        deadline = time.monotonic() + 0.5 * ttl
+        budget = min(self.REQUEST_TIMEOUT, 0.32 * ttl)
 
-        def renew() -> bool:
-            if self._gw.lease_keepalive(lease_id, timeout=t()) <= 0:
-                return False
-            # The LeaseKV contract: extend iff the key still holds OUR
-            # value. A lease can outlive the key (operator `etcdctl del`
-            # to force a new election, or an overwrite): renewing on the
-            # lease alone would leave two masters.
-            try:
-                held = self._gw.get(key, timeout=t())
+        outcome: "bool | None" = None
+        for attempt in range(2):
+            t = self._per_request(budget)
+
+            def renew() -> "bool | None":
+                if self._gw.lease_keepalive(lease_id, timeout=t()) <= 0:
+                    return False  # lease gone: definite loss
+                # The LeaseKV contract: extend iff the key still holds
+                # OUR value. A lease can outlive the key (operator
+                # `etcdctl del` to force a new election, or an
+                # overwrite): renewing on the lease alone would leave
+                # two masters.
+                try:
+                    held = self._gw.get(key, timeout=t())
+                except Exception:
+                    return None  # can't verify ownership: transient
                 ours = held is not None and held.decode() == value
-            except Exception:
-                ours = False  # can't verify ownership: step down
-            if not ours:
-                # The keepalive above just re-extended the lease to a
-                # full TTL; abandoning it now would pin a stale lock key
-                # for that long with nobody renewing — a full-TTL
-                # leaderless window. Release it so re-election is
-                # immediate.
-                self._revoke_quietly(lease_id)
-            return ours
+                if not ours:
+                    # The keepalive above just re-extended the lease to
+                    # a full TTL; abandoning it now would pin a stale
+                    # lock key for that long with nobody renewing — a
+                    # full-TTL leaderless window. Release it so
+                    # re-election is immediate.
+                    self._revoke_quietly(lease_id)
+                return ours
 
-        try:
-            ok = await self._call(renew, budget)
-        except asyncio.CancelledError:
-            # stop() mid-renewal: the thread's keepalive may have just
-            # extended the lease to a full TTL; do not leave it pinned
-            # by a master that no longer exists.
-            self._spawn_revoke(lease_id)
-            self._leases.pop(key, None)
-            raise
+            try:
+                outcome = await self._call(renew, budget)
+            except asyncio.CancelledError:
+                # stop() mid-renewal: the thread's keepalive may have
+                # just extended the lease to a full TTL; do not leave
+                # it pinned by a master that no longer exists.
+                self._spawn_revoke(lease_id)
+                self._leases.pop(key, None)
+                raise
+            if outcome is not None:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.05 * ttl:
+                break  # no meaningful retry window left
+            budget = min(self.REQUEST_TIMEOUT, remaining / 1.25)
+        ok = bool(outcome)
         if not ok:
             # Mastership is lost; a fresh acquire grants a fresh lease.
             # The thread may still be mid-renewal (timeout), or its own

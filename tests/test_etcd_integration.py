@@ -371,3 +371,57 @@ def test_degenerate_empty_close_endpoint_is_not_sticky(fake):
     assert gw.endpoints[gw._watch_endpoint].endswith(fake.address)
     httpd.shutdown()
     httpd.server_close()
+
+
+def test_refresh_survives_one_transient_hiccup(fake):
+    """A single transient failure mid-renewal (slow etcd round-trip, a
+    starved executor thread) must NOT read as mastership loss — the
+    refresh retries once within its split budget. Definite losses
+    (lease gone) still step down without retrying."""
+
+    async def body():
+        kv = EtcdKV([fake.address])
+        assert await kv.acquire("/lock", "me", 10.0)
+
+        orig_get = kv._gw.get
+        calls = {"n": 0}
+
+        def flaky_get(key, timeout=30.0):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient blip")
+            return orig_get(key, timeout=timeout)
+
+        kv._gw.get = flaky_get
+        assert await kv.refresh("/lock", "me", 10.0) is True
+        assert calls["n"] == 2  # retried exactly once
+        # Still master: a later clean refresh works too.
+        assert await kv.refresh("/lock", "me", 10.0) is True
+
+    asyncio.run(body())
+
+
+def test_refresh_definite_loss_does_not_retry(fake):
+    """Lease revoked out from under the holder: keepalive reports TTL 0
+    and the refresh steps down on the FIRST attempt (a retry could only
+    widen the window in which a standby and the deposed master both
+    think they hold the lock)."""
+
+    async def body():
+        kv = EtcdKV([fake.address])
+        assert await kv.acquire("/lock", "me", 10.0)
+        lease_id = kv._leases["/lock"]
+        fake.expire_lease(lease_id)
+
+        keepalives = {"n": 0}
+        orig_ka = kv._gw.lease_keepalive
+
+        def counting_ka(lid, timeout=30.0):
+            keepalives["n"] += 1
+            return orig_ka(lid, timeout=timeout)
+
+        kv._gw.lease_keepalive = counting_ka
+        assert await kv.refresh("/lock", "me", 10.0) is False
+        assert keepalives["n"] == 1  # no retry on a definite loss
+
+    asyncio.run(body())

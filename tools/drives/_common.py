@@ -38,6 +38,27 @@ def pin_platform_in_process() -> None:
             jax.config.update("jax_enable_x64", True)
 
 
+def ensure_ports_free(*ports) -> None:
+    """Fail LOUDLY if a drive's fixed port is already bound — a stale
+    server leaked by an earlier interrupted run otherwise answers the
+    drive's clients with confusing not-master errors (a zombie from a
+    killed parent whose `finally: stop()` never ran cost a debugging
+    session). Run this before spawning anything."""
+    import socket
+
+    for port in ports:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", port))
+            except OSError as e:
+                raise SystemExit(
+                    f"port {port} is already in use (stale server from an "
+                    f"interrupted drive? `pkill -f doorman_tpu.cmd.server` "
+                    f"and retry): {e}"
+                )
+
+
 def spawn(args, name="proc"):
     """Start a child with stdout+stderr appended to a temp log file
     (returned alongside, for tailing on failure). The parent closes its

@@ -7,7 +7,7 @@ import subprocess
 import sys
 import time
 
-from _common import platform_args, require_backend, REPO, spawn, stop, tail, write_config
+from _common import ensure_ports_free, platform_args, require_backend, REPO, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
 
@@ -27,6 +27,7 @@ resources:
 """)
 
 port = 15322
+ensure_ports_free(port)
 proc = spawn(
     [sys.executable, "-m", "doorman_tpu.cmd.server",
      "--port", str(port), "--debug-port", "-1",

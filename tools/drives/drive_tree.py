@@ -10,7 +10,7 @@ import sys
 import time
 import urllib.request
 
-from _common import platform_args, require_backend, spawn, stop, tail, write_config
+from _common import ensure_ports_free, platform_args, require_backend, spawn, stop, tail, write_config
 
 require_backend()
 
@@ -33,6 +33,7 @@ resources:
 """)
 
 ROOT, INTER, ROOT_DEBUG = 15710, 15711, 15760
+ensure_ports_free(ROOT, INTER, ROOT_DEBUG)
 root = spawn(
     [sys.executable, "-m", "doorman_tpu.cmd.server",
      "--port", str(ROOT), "--debug-port", str(ROOT_DEBUG),

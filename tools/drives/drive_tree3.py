@@ -26,7 +26,7 @@ import sys
 import time
 import urllib.request
 
-from _common import platform_args, require_backend, spawn, stop, tail, write_config
+from _common import ensure_ports_free, platform_args, require_backend, spawn, stop, tail, write_config
 
 require_backend()
 
@@ -53,6 +53,7 @@ resources:
 
 ROOT, REGION, LEAF = 15720, 15721, 15722
 DBG_ROOT, DBG_REGION, DBG_LEAF = 15770, 15771, 15772
+ensure_ports_free(ROOT, REGION, LEAF, DBG_ROOT, DBG_REGION, DBG_LEAF)
 
 # Refresh-decay convergence bound: propagation lag is at most ~one
 # refresh + one tick per hop each way, so steady state must arrive

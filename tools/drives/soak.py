@@ -10,7 +10,7 @@ import os
 import sys
 import time
 
-from _common import platform_args, require_backend, spawn, stop, tail, write_config
+from _common import ensure_ports_free, platform_args, require_backend, spawn, stop, tail, write_config
 
 from tests.fake_etcd import FakeEtcd
 
@@ -33,6 +33,7 @@ resources:
 """)
 
 port = 15400
+ensure_ports_free(port, 15450)  # serving + debug ports
 server = spawn(
     [sys.executable, "-m", "doorman_tpu.cmd.server",
      "--port", str(port), "--debug-port", "15450",
