@@ -46,32 +46,65 @@ import numpy as np
 # outages (round-4 lesson: the measured numbers lived only in prose
 # while BENCH_r04 recorded backend_unreachable).
 _EMITTED: list = []
+_PLATFORM_INFO: dict = {}
 
 
 def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
     _EMITTED.append(obj)
+    # Incremental artifact: every emitted result lands on disk
+    # IMMEDIATELY, so a mid-run backend outage (the round-5 failure
+    # mode: the tunnel died during bench_server_tick_wide and the
+    # whole artifact was lost) discards nothing already measured.
+    try:
+        write_artifact(complete=False)
+    except Exception:
+        pass  # artifact trouble must never kill a measurement run
 
 
-def write_artifact() -> None:
+def _platform_info() -> dict:
+    """Device identity for the artifact, cached after the first
+    success. jax.devices() can HANG when the tunnel is down — it is
+    only ever called here after benches already ran device work, and a
+    failure degrades to 'unknown' instead of discarding results."""
+    if not _PLATFORM_INFO:
+        import platform
+
+        try:
+            import jax
+
+            _PLATFORM_INFO.update(
+                platform=jax.devices()[0].platform,
+                device=str(jax.devices()[0]),
+            )
+        except Exception:
+            _PLATFORM_INFO.update(platform="unknown", device="unknown")
+        _PLATFORM_INFO["host"] = platform.node()
+    return dict(_PLATFORM_INFO)
+
+
+def write_artifact(complete: bool = True) -> None:
     import os
-    import platform
-
-    import jax
 
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "doc", "bench_last.json"
     )
+    info = _platform_info()
     record = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "platform": jax.devices()[0].platform,
-        "device": str(jax.devices()[0]),
-        "host": platform.node(),
+        "platform": info["platform"],
+        "device": info["device"],
+        "host": info["host"],
+        # False marks a partial artifact (run still going, or died
+        # mid-run): the results list holds everything emitted so far.
+        "complete": complete,
         "results": _EMITTED,
     }
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(record, f, indent=1)
         f.write("\n")
+    os.replace(tmp, path)
 
 NUM_CLIENTS = 1_000_000
 NUM_RESOURCES = 10_000
@@ -773,9 +806,16 @@ def _require_backend() -> None:
 if __name__ == "__main__":
     _require_backend()
     gate_pallas_kernels()
-    main()
-    bench_server_tick_wide()
-    # The narrow server tick stays LAST: the driver parses the final
-    # JSON line as the round's headline metric.
-    bench_server_tick()
-    write_artifact()
+    try:
+        main()
+        bench_server_tick_wide()
+        # The narrow server tick stays LAST: the driver parses the final
+        # JSON line as the round's headline metric.
+        bench_server_tick()
+    finally:
+        # A crash mid-sequence still flushes everything emitted so far
+        # (emit() also writes incrementally; this is the completeness
+        # marker — complete=True only when the whole sequence ran).
+        import sys as _sys
+
+        write_artifact(complete=_sys.exc_info()[0] is None)

@@ -1,0 +1,161 @@
+"""Shipped fault plans: the standing chaos regression suite.
+
+Each plan is small enough to run in the tier-1 smoke suite (tens of
+virtual ticks, milliseconds-to-seconds of wall clock on CPU) and is the
+replay artifact for its scenario — `python -m doorman_tpu.cmd.chaos
+--plan master_flap` runs one by name, `--save-plan` dumps the JSON for
+editing. Timelines below are in ticks (1 virtual second each).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from doorman_tpu.chaos.plan import FaultEvent, FaultPlan
+
+
+def master_flap() -> FaultPlan:
+    """Two candidates; the master's etcd view browns out past the lock
+    TTL. Expect: step-down without split-brain, the standby wins after
+    the lock lapses, clients chase the redirect once the old master's
+    watcher heals, allocation returns to baseline via learning mode."""
+    return FaultPlan(
+        name="master_flap",
+        seed=1,
+        setup={
+            "servers": 2,
+            "clients": 3,
+            "wants": [20.0, 30.0, 60.0],
+            "capacity": 100,
+            "mode": "immediate",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 3,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=7, kind="kv_drop", target="s0",
+                       duration_ticks=5),
+        ],
+        warmup_ticks=7,
+        total_ticks=24,
+        reconverge_ticks=8,
+    )
+
+
+def etcd_brownout() -> FaultPlan:
+    """One master, three phases: a single dropped renewal round-trip
+    (must be survived by the transient-retry tolerance), one spurious
+    NOT_MASTER on the client link (one failed refresh, lease retained),
+    then a sustained brownout past the TTL (mastership lost, lock
+    lapses, the same server re-wins and relearns)."""
+    return FaultPlan(
+        name="etcd_brownout",
+        seed=2,
+        setup={
+            "servers": 1,
+            "clients": 3,
+            "wants": [15.0, 25.0, 40.0],
+            "capacity": 60,
+            "mode": "immediate",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 3,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=7, kind="kv_drop", target="s0",
+                       duration_ticks=1, params={"calls": 1}),
+            FaultEvent(at_tick=8, kind="grpc_not_master",
+                       target="link:s0", duration_ticks=1,
+                       params={"calls": 1}),
+            FaultEvent(at_tick=9, kind="kv_drop", target="s0",
+                       duration_ticks=4),
+        ],
+        warmup_ticks=7,
+        total_ticks=20,
+        reconverge_ticks=6,
+    )
+
+
+def device_tunnel_outage() -> FaultPlan:
+    """Batch server on the resident tick path: the device solve dies
+    mid-rotation for three ticks (tick errors, not crashes — stores
+    keep serving last solved grants), then a ResidentOverflow forces
+    the BatchSolver fallback, then one slow solve. Allocation never
+    deviates from baseline."""
+    return FaultPlan(
+        name="device_tunnel_outage",
+        seed=3,
+        setup={
+            "servers": 1,
+            "clients": 4,
+            "wants": [10.0, 20.0, 30.0, 40.0],
+            "capacity": 80,
+            "mode": "batch",
+            "native_store": True,
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=7, kind="solver_error", target="s0",
+                       duration_ticks=3),
+            FaultEvent(at_tick=10, kind="resident_overflow", target="s0",
+                       duration_ticks=1, params={"calls": 1}),
+            FaultEvent(at_tick=11, kind="solver_slow", target="s0",
+                       duration_ticks=1,
+                       params={"calls": 1, "seconds": 0.02}),
+        ],
+        warmup_ticks=7,
+        total_ticks=20,
+        reconverge_ticks=6,
+    )
+
+
+def intermediate_partition() -> FaultPlan:
+    """Root + intermediate + clients on the intermediate: the
+    intermediate<->root hop partitions for longer than the parent lease,
+    so the intermediate's capacity decays to zero (clients degrade, no
+    overcommit), then heals and re-leases from the root."""
+    return FaultPlan(
+        name="intermediate_partition",
+        seed=4,
+        setup={
+            "servers": 1,
+            "intermediate": True,
+            "clients": 3,
+            "wants": [10.0, 20.0, 30.0],
+            "capacity": 90,
+            "mode": "immediate",
+            "lease_length": 6,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=6, kind="grpc_drop", target="link:s0",
+                       duration_ticks=9),
+        ],
+        warmup_ticks=6,
+        total_ticks=24,
+        reconverge_ticks=6,
+    )
+
+
+PLANS: Dict[str, "callable"] = {
+    "master_flap": master_flap,
+    "etcd_brownout": etcd_brownout,
+    "device_tunnel_outage": device_tunnel_outage,
+    "intermediate_partition": intermediate_partition,
+}
+
+
+def get_plan(name: str) -> FaultPlan:
+    try:
+        return PLANS[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown plan {name!r}; shipped plans: {sorted(PLANS)}"
+        ) from None

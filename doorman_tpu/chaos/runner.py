@@ -1,0 +1,395 @@
+"""The chaos runner: drive the real stack through a FaultPlan.
+
+Everything runs in-process on the CPU backend, but nothing is mocked:
+real CapacityServer instances serve real gRPC on loopback (through
+ChaosGrpcProxy hops), real Client instances refresh leases, the
+election runs the real TTL-lock protocol over a LeaseKV, and batch
+servers run the real device solve. What makes a run DETERMINISTIC is
+that no component owns a timer: the runner advances one shared
+ChaosClock tick by tick and explicitly steps every periodic loop
+(election renewal, parent refresh, batch tick, client refresh) in a
+fixed order, so the same plan + seed replays the same event log
+byte-for-byte.
+
+Stepping an election rather than running KVElection's sleep-based loops
+keeps the protocol (campaign with acquire, renew every ttl/3, lose on
+failed renewal, broadcast the holder) and the EtcdKV renewal-retry
+tolerance (one transient transport failure retries; definite losses
+never do) while moving the cadence into virtual time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+from doorman_tpu.chaos.clock import ChaosClock
+from doorman_tpu.chaos.injectors import (
+    ChaosGrpcProxy,
+    ChaosLeaseKV,
+    FaultInjected,
+    FaultState,
+    PortInjector,
+    SolverInjector,
+)
+from doorman_tpu.chaos.invariants import InvariantChecker, Violation
+from doorman_tpu.chaos.plan import FaultPlan
+from doorman_tpu.client.client import Client
+from doorman_tpu.client.connection import Connection
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import Election, InMemoryKV, TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+LOCK = "/chaos/master"
+RESOURCE = "r0"
+# Events of these kinds happen once when applied, instead of arming a
+# fault window on the switchboard.
+ACTIONS = frozenset({"kv_expire_lock", "port_bind"})
+
+
+class SteppedElection(Election):
+    """KVElection's TTL-lock state machine, driven by explicit step()
+    calls in virtual time (see module docstring)."""
+
+    def __init__(self, kv, lock: str, *, ttl: float, clock):
+        self._kv = kv
+        self._lock = lock
+        self._ttl = ttl
+        self._clock = clock
+        self._id: Optional[str] = None
+        self._cb_master = None
+        self._cb_current = None
+        self.is_master = False
+        self._next_renew = 0.0
+        self._last_current: Optional[str] = None
+
+    def __str__(self) -> str:
+        return f"stepped kv lock: {self._lock} (ttl {self._ttl}s)"
+
+    async def run(self, id, on_is_master, on_current) -> None:
+        self._id = id
+        self._cb_master = on_is_master
+        self._cb_current = on_current
+
+    async def step(self) -> None:
+        now = self._clock()
+        if self.is_master:
+            if now >= self._next_renew:
+                if await self._refresh_with_retry():
+                    self._next_renew = now + self._ttl / 3.0
+                else:
+                    self.is_master = False
+                    await self._cb_master(False)
+        else:
+            try:
+                won = await self._kv.acquire(self._lock, self._id, self._ttl)
+            except FaultInjected:
+                won = False
+            if won:
+                self.is_master = True
+                self._next_renew = now + self._ttl / 3.0
+                await self._cb_master(True)
+        # The watcher half: broadcast the current holder. A dropped
+        # read keeps the last known value (exactly what a partitioned
+        # watcher would believe).
+        try:
+            current = await self._kv.get(self._lock) or ""
+        except FaultInjected:
+            current = self._last_current or ""
+        if current != self._last_current:
+            self._last_current = current
+            await self._cb_current(current)
+
+    async def _refresh_with_retry(self) -> bool:
+        """One transient transport failure retries within the renewal
+        window (the stepped mirror of EtcdKV.refresh's tolerance); a
+        second failure — or a definite loss — reads as mastership
+        lost."""
+        for attempt in range(2):
+            try:
+                return await self._kv.refresh(self._lock, self._id, self._ttl)
+            except FaultInjected:
+                pass
+        return False
+
+
+async def _cancel_background(server: CapacityServer) -> None:
+    """The runner owns all cadence: server-internal timer loops (batch
+    tick, parent updater) must not race the stepped schedule."""
+    for t in server._tasks:
+        t.cancel()
+    for t in server._tasks:
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):
+            pass
+    server._tasks.clear()
+
+
+class ChaosRunner:
+    """Builds the plan's topology, drives it tick by tick, and returns
+    a JSON-able verdict."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.clock = ChaosClock()
+        self.state = FaultState(plan.seed)
+        self.ports = PortInjector()
+        self.bound_ports: List[int] = []
+        self.servers: Dict[str, CapacityServer] = {}
+        self.proxies: Dict[str, ChaosGrpcProxy] = {}
+        self.elections: Dict[str, SteppedElection] = {}
+        self.clients: List[Client] = []
+        self.kv: Optional[InMemoryKV] = None
+        self.log: List[list] = []
+        self.violations: List[Violation] = []
+
+    # -- setup ----------------------------------------------------------
+
+    def _config_yaml(self) -> str:
+        s = self.plan.setup
+        safe = s.get("safe_capacity")
+        safe_line = f"  safe_capacity: {safe}\n" if safe is not None else ""
+        return (
+            "resources:\n"
+            f"- identifier_glob: \"*\"\n"
+            f"  capacity: {s.get('capacity', 100)}\n"
+            + safe_line
+            + "  algorithm: {"
+            + f"kind: {s.get('algorithm', 'PROPORTIONAL_SHARE')}, "
+            + f"lease_length: {s.get('lease_length', 60)}, "
+            + f"refresh_interval: {s.get('refresh_interval', 1)}, "
+            + f"learning_mode_duration: {s.get('learning_mode_duration', 3)}"
+            + "}\n"
+        )
+
+    async def _setup(self) -> None:
+        s = self.plan.setup
+        self.kv = InMemoryKV(clock=self.clock)
+        config = parse_yaml_config(self._config_yaml())
+        for i in range(int(s.get("servers", 1))):
+            name = f"s{i}"
+            proxy = ChaosGrpcProxy(self.state, link=f"link:{name}")
+            await proxy.start()
+            election = SteppedElection(
+                ChaosLeaseKV(self.kv, self.state, name),
+                LOCK, ttl=float(s.get("election_ttl", 3.0)),
+                clock=self.clock,
+            )
+            server = CapacityServer(
+                proxy.address, election,
+                mode=s.get("mode", "immediate"),
+                tick_interval=self.plan.tick_interval,
+                minimum_refresh_interval=0.0,
+                clock=self.clock,
+                native_store=bool(s.get("native_store", False)),
+            )
+            SolverInjector(self.state, name).install(server)
+            await server.start(0, host="127.0.0.1")
+            await _cancel_background(server)
+            proxy.backend = server
+            await server.load_config(config)
+            self.servers[name] = server
+            self.proxies[name] = proxy
+            self.elections[name] = election
+
+        attach = self.proxies["s0"].address
+        if s.get("intermediate"):
+            proxy = ChaosGrpcProxy(self.state, link="link:inter")
+            await proxy.start()
+            inter = CapacityServer(
+                proxy.address, TrivialElection(),
+                parent_addr=self.proxies["s0"].address,
+                mode="immediate",
+                minimum_refresh_interval=0.0,
+                clock=self.clock,
+            )
+            # Bounded parent refreshes: the runner retries next tick
+            # instead of letting the connection retry-forever inside one.
+            inter._parent_conn = Connection(
+                inter.parent_addr, minimum_refresh_interval=0.0,
+                max_retries=0,
+            )
+            await inter.start(0, host="127.0.0.1")
+            await _cancel_background(inter)
+            proxy.backend = inter
+            if s.get("skip_intermediate_learning", True):
+                # The self-config default template carries a 20s
+                # learning window; an intermediate that just booted
+                # (not failed over) has no state to relearn.
+                inter.became_master_at -= 10_000.0
+            self.servers["inter"] = inter
+            self.proxies["inter"] = proxy
+            attach = proxy.address
+
+        wants = s.get("wants") or [
+            10.0 * (i + 1) for i in range(int(s.get("clients", 3)))
+        ]
+        for i, w in enumerate(wants):
+            client = Client(
+                attach, f"c{i}", minimum_refresh_interval=0.0,
+                max_retries=0, clock=self.clock,
+            )
+            await client.resource(RESOURCE, float(w))
+            self.clients.append(client)
+
+    async def _teardown(self) -> None:
+        for client in self.clients:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        for proxy in self.proxies.values():
+            await proxy.stop()
+        for server in self.servers.values():
+            try:
+                await server.stop()
+            except Exception:
+                pass
+        self.ports.release_all()
+
+    # -- the drive ------------------------------------------------------
+
+    def _apply_event(self, ev, tick: int) -> None:
+        if ev.kind == "kv_expire_lock":
+            self.kv.expire(LOCK)
+        elif ev.kind == "port_bind":
+            self.bound_ports.append(self.ports.bind())
+        else:
+            self.state.start(ev)
+        self.log.append(
+            [tick, "fault", ev.kind, ev.target, ev.duration_ticks]
+        )
+
+    def _snapshot(self) -> Dict[str, float]:
+        return {
+            f"{cl.id}/{rid}": res.current_capacity()
+            for cl in self.clients
+            for rid, res in cl.resources.items()
+        }
+
+    @staticmethod
+    def _matches(a: Dict[str, float], b: Dict[str, float]) -> bool:
+        return a.keys() == b.keys() and all(
+            abs(a[k] - b[k]) <= 1e-9 for k in a
+        )
+
+    async def run(self) -> dict:
+        plan = self.plan
+        await self._setup()
+        try:
+            checker = InvariantChecker(
+                self.clock,
+                lease_length=float(plan.setup.get("lease_length", 60)),
+            )
+            groups = [[n for n in self.servers if n.startswith("s")]]
+            heal_tick = plan.heal_tick
+            baseline: Optional[Dict[str, float]] = None
+            converged_at: Optional[int] = None
+            degraded = False
+            last_masters: tuple = ()
+            inter = self.servers.get("inter")
+
+            for tick in range(plan.total_ticks):
+                self.state.begin_tick(tick)
+                for ev in plan.events_at(tick):
+                    self._apply_event(ev, tick)
+                if tick == heal_tick and plan.events:
+                    self.log.append([tick, "heal"])
+
+                for election in self.elections.values():
+                    await election.step()
+                masters = tuple(sorted(
+                    n for n, srv in self.servers.items()
+                    if n != "inter" and srv.is_master
+                ))
+                if masters != last_masters:
+                    last_masters = masters
+                    self.log.append([tick, "master", list(masters)])
+
+                if inter is not None:
+                    await inter._perform_parent_requests(0)
+
+                for name, server in self.servers.items():
+                    if (
+                        server.mode == "batch"
+                        and server.is_master
+                        and server.resources
+                    ):
+                        try:
+                            await server.tick_once()
+                        except Exception as e:
+                            self.log.append(
+                                [tick, "tick_error", name, str(e)]
+                            )
+
+                for client in self.clients:
+                    await client.refresh_once()
+
+                for v in checker.check_tick(
+                    tick, self.servers, groups, self.clients
+                ):
+                    self.violations.append(v)
+                    self.log.append([tick] + v.as_log())
+
+                if tick == plan.warmup_ticks - 1:
+                    baseline = self._snapshot()
+                if baseline is not None and not degraded:
+                    # First tick where clients collectively hold LESS
+                    # than the baseline: the fault visibly bit (plans
+                    # assert this so they cannot pass vacuously).
+                    total = sum(self._snapshot().values())
+                    if total < sum(baseline.values()) - 1e-9:
+                        degraded = True
+                        self.log.append([tick, "degraded"])
+                if (
+                    baseline is not None
+                    and converged_at is None
+                    and tick >= heal_tick
+                    and self._matches(self._snapshot(), baseline)
+                ):
+                    converged_at = tick
+                    self.log.append(
+                        [tick, "converged", tick - heal_tick]
+                    )
+
+                self.clock.advance(plan.tick_interval)
+        finally:
+            await self._teardown()
+
+        reconverged = converged_at is not None and (
+            converged_at - heal_tick <= plan.reconverge_ticks
+        )
+        if converged_at is None and baseline is not None:
+            self.violations.append(Violation(
+                plan.total_ticks, "reconvergence", RESOURCE,
+                f"no reconvergence within {plan.total_ticks - heal_tick} "
+                f"post-heal ticks (budget {plan.reconverge_ticks})",
+            ))
+            self.log.append(
+                [plan.total_ticks] + self.violations[-1].as_log()
+            )
+        log_bytes = json.dumps(
+            self.log, sort_keys=True, separators=(",", ":")
+        ).encode()
+        return {
+            "plan": plan.name,
+            "seed": plan.seed,
+            "ok": not self.violations and reconverged,
+            "ticks": plan.total_ticks,
+            "heal_tick": heal_tick,
+            "converged_after_heal_ticks": (
+                None if converged_at is None else converged_at - heal_tick
+            ),
+            "violations": [v.as_log() for v in self.violations],
+            "event_log": self.log,
+            "log_sha256": hashlib.sha256(log_bytes).hexdigest(),
+        }
+
+
+def run_plan(plan: FaultPlan) -> dict:
+    """Synchronous convenience: build a runner, drive the plan, return
+    the verdict."""
+    return asyncio.run(ChaosRunner(plan).run())

@@ -110,11 +110,19 @@ class Client:
         minimum_refresh_interval: float = 5.0,
         tls: bool = False,
         tls_ca: Optional[str] = None,
+        max_retries: Optional[int] = None,
+        clock: Callable[[], float] = time.time,
     ):
+        """`max_retries` bounds each RPC's internal retry loop (None =
+        the reference's retry-forever). `clock` is the wall-clock used
+        for lease-expiry decisions; the chaos harness injects a virtual
+        clock here so outage expiry is deterministic."""
         self.id = client_id or _default_client_id()
+        self._clock = clock
         self.conn = Connection(
             addr,
             minimum_refresh_interval=minimum_refresh_interval,
+            max_retries=max_retries,
             tls=tls,
             tls_ca=tls_ca,
         )
@@ -220,6 +228,14 @@ class Client:
                 continue
             interval, retry = await self._perform_requests(retry)
 
+    async def refresh_once(self) -> bool:
+        """Run one bulk-refresh cycle synchronously (no background task
+        involved); returns True when the RPC succeeded. Step-driven
+        harnesses (doorman_tpu.chaos) and tests use this to control the
+        refresh cadence deterministically."""
+        _, retry = await self._perform_requests(0)
+        return retry == 0
+
     async def _perform_requests(self, retry_number: int):
         request = pb.GetCapacityRequest(client_id=self.id)
         for resource_id, res in self.resources.items():
@@ -237,7 +253,7 @@ class Client:
         # bound tightens to the soonest lease expiry so the fallback is
         # timely, then the next cycle retries (the reference's client
         # likewise runs discrete periodic attempts, client.go:227-294).
-        now = time.time()
+        now = self._clock()
         soonest = min(
             (
                 res.expires()
@@ -268,7 +284,7 @@ class Client:
         except Exception:
             log.exception("%s: on_request hook raised", self.id)
         if failed:
-            now = time.time()
+            now = self._clock()
             for res in self.resources.values():
                 if res.lease is not None and res.expires() < now:
                     # Lease expired during the outage: fall back to the

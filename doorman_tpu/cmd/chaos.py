@@ -1,0 +1,84 @@
+"""Chaos CLI: run a fault plan against the real stack and print the
+verdict.
+
+    python -m doorman_tpu.cmd.chaos --plan master_flap
+    python -m doorman_tpu.cmd.chaos --plan /path/to/plan.json
+    python -m doorman_tpu.cmd.chaos --list
+    python -m doorman_tpu.cmd.chaos --save-plan master_flap plan.json
+
+Exit code 0 when every invariant held and the allocation reconverged
+within the plan's budget; 1 otherwise. The verdict (JSON, one object)
+goes to stdout — the event_log and log_sha256 in it are the replay
+contract: rerunning the same plan file reproduces them byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from doorman_tpu.chaos.plan import FaultPlan
+from doorman_tpu.chaos.plans import PLANS, get_plan
+from doorman_tpu.chaos.runner import ChaosRunner
+from doorman_tpu.utils import flagenv
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="doorman-chaos",
+        description="run a doorman-tpu chaos fault plan",
+    )
+    p.add_argument("--plan", default="",
+                   help="shipped plan name or path to a plan JSON file")
+    p.add_argument("--list", action="store_true",
+                   help="list shipped plans and exit")
+    p.add_argument("--save-plan", nargs=2, metavar=("NAME", "PATH"),
+                   default=None,
+                   help="write a shipped plan's JSON to PATH and exit")
+    p.add_argument("--out", default="",
+                   help="also write the verdict JSON to this path")
+    return p
+
+
+def load_plan(spec: str) -> FaultPlan:
+    if os.path.exists(spec):
+        return FaultPlan.load(spec)
+    return get_plan(spec)
+
+
+async def run(args: argparse.Namespace) -> int:
+    if args.list:
+        for name in sorted(PLANS):
+            print(name)
+        return 0
+    if args.save_plan is not None:
+        name, path = args.save_plan
+        get_plan(name).save(path)
+        print(f"wrote {name} to {path}")
+        return 0
+    if not args.plan:
+        print("--plan is required (or --list / --save-plan)",
+              file=sys.stderr)
+        return 2
+    plan = load_plan(args.plan)
+    verdict = await ChaosRunner(plan).run()
+    text = json.dumps(verdict, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0 if verdict["ok"] else 1
+
+
+def main(argv=None) -> None:
+    parser = make_parser()
+    flagenv.populate(parser)
+    args = parser.parse_args(argv)
+    raise SystemExit(asyncio.run(run(args)))
+
+
+if __name__ == "__main__":
+    main()

@@ -151,8 +151,12 @@ class EtcdKV(LeaseKV):
     # split-brain window.
     REQUEST_TIMEOUT = 5.0
 
-    def __init__(self, endpoints: list[str]):
-        self._gw = EtcdGateway(endpoints)
+    def __init__(self, endpoints: list[str],
+                 gateway: Optional[EtcdGateway] = None):
+        """`gateway` substitutes a pre-built gateway client (the chaos
+        harness injects a fault-wrapping one); default builds the
+        shared EtcdGateway over `endpoints`."""
+        self._gw = gateway or EtcdGateway(endpoints)
         self._leases: Dict[str, int] = {}  # lock key -> held lease id
         self._fast_watches = 0  # consecutive instant watch returns
 

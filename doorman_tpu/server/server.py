@@ -598,13 +598,21 @@ class CapacityServer(CapacityServicer):
                     # A narrow lane resource outgrew the dense bucket
                     # mid-tick: force a re-partition (it lands in the
                     # wide set next tick) and run this tick through the
-                    # BatchSolver (correct at any width).
+                    # BatchSolver (correct at any width). BOTH in-flight
+                    # handles are dropped, not just the narrow one: a
+                    # pre-overflow wide handle collected after this
+                    # fallback would overwrite the fresher batch-applied
+                    # grants with one-tick-stale ones (the chunk-version
+                    # guard only catches membership changes, not value
+                    # staleness). Dropping an uncollected handle is
+                    # documented as benign.
                     log.warning(
                         "%s: resident bucket overflow; re-partitioning "
                         "wide resources", self.id,
                     )
                     self._resident_ok_key = None
                     self._resident_handle = None
+                    self._resident_wide_handle = None
                     run_tick()
 
             await loop.run_in_executor(None, resident_or_fallback)

@@ -481,6 +481,21 @@ class WideResidentSolver:
         f_s = np.zeros(Df, dtype)
         f_a = np.zeros(Df, bool)
         wpos = fpos = 0
+        # One-tick UPLOAD-side inconsistency window: pack_slots reads
+        # LIVE engine state, after the drain above. A swap-remove
+        # landing between the drain and this pack makes a wants-only
+        # (level-1) slot ship the NEW occupant's wants while the old
+        # occupant's has/subclients/active lanes are still on device —
+        # that resource's shared totals are slightly skewed for every
+        # chunk of THIS solve. It self-corrects in one tick: the
+        # membership change bumped the chunk version, so the version
+        # guard (read before this pack — see chunk_versions) blocks the
+        # skewed chunk's write-back, and the re-marked slots re-deliver
+        # a consistent solve next tick. This is the upload-side sibling
+        # of the module docstring's download staleness bound ("lag but
+        # never lead" covers the write-back only); pinned by
+        # tests/test_resident_wide.py::
+        # test_drain_remove_pack_interleaving_converges.
         for rid in np.unique(slot_rids) if len(slot_rids) else ():
             m = slot_rids == rid
             pw, phas, psub, pact = self._engine.pack_slots(

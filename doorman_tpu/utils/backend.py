@@ -20,6 +20,7 @@ def wait_for_backend(
     attempts: int = 3,
     per_timeout_s: float = 180.0,
     cwd: Optional[str] = None,
+    probe_argv=None,
 ) -> Optional[str]:
     """Wait out a device-tunnel blip: probe the backend in a THROWAWAY
     subprocess every attempt (a fresh process re-initializes JAX, so a
@@ -31,17 +32,27 @@ def wait_for_backend(
     seconds of wall clock; per_timeout_s defaults to the full single
     window a slow-but-healthy cold init can legitimately need. Returns
     None once a probe succeeds, else the last failure reason. Progress
-    goes to stderr so a long wait is visibly a wait."""
+    goes to stderr so a long wait is visibly a wait.
+
+    `probe_argv` substitutes the probe command — a list, or a callable
+    returning one, resolved fresh per attempt (the chaos harness
+    injects failing/healing probes this way to pin the retry
+    classification deterministically)."""
     import subprocess
     import sys
 
+    default_argv = [sys.executable, "-c",
+                    "import jax; jax.devices(); print('ok')"]
     reason = "backend probe never ran"
     for attempt in range(1, attempts + 1):
         attempt_start = time.monotonic()
+        if callable(probe_argv):
+            argv = probe_argv()
+        else:
+            argv = probe_argv or default_argv
         try:
             proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; jax.devices(); print('ok')"],
+                argv,
                 capture_output=True, text=True, timeout=per_timeout_s,
                 cwd=cwd,
             )

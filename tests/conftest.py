@@ -19,3 +19,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soaks excluded from tier-1 (deselected by -m 'not slow')",
+    )

@@ -1,0 +1,53 @@
+"""FaultPlan serialization and replay determinism.
+
+The plan is the replay artifact: its JSON round-trips byte-identically,
+and running the same plan twice produces the same event log (the
+acceptance contract for every chaos scenario)."""
+
+import asyncio
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.chaos import ChaosRunner, FaultEvent, FaultPlan, get_plan
+from doorman_tpu.chaos.plans import PLANS
+
+
+def test_plan_json_round_trip_is_byte_identical():
+    for name in PLANS:
+        plan = get_plan(name)
+        text = plan.to_json()
+        again = FaultPlan.from_json(text)
+        assert again == plan
+        assert again.to_json() == text  # canonical form is a fixpoint
+
+
+def test_plan_save_load(tmp_path):
+    plan = get_plan("master_flap")
+    path = tmp_path / "plan.json"
+    plan.save(str(path))
+    assert FaultPlan.load(str(path)) == plan
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        FaultEvent(at_tick=0, kind="gremlins")
+
+
+def test_event_inside_warmup_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan(
+            name="bad", seed=0, setup={},
+            events=[FaultEvent(at_tick=1, kind="kv_drop")],
+            warmup_ticks=5,
+        )
+
+
+def test_same_seed_and_plan_replays_identical_event_log():
+    plan = get_plan("master_flap")
+    v1 = asyncio.run(ChaosRunner(plan).run())
+    v2 = asyncio.run(ChaosRunner(FaultPlan.from_json(plan.to_json())).run())
+    assert v1["event_log"] == v2["event_log"]
+    assert v1["log_sha256"] == v2["log_sha256"]
+    assert v1["ok"] and v2["ok"]

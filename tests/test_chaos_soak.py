@@ -1,0 +1,50 @@
+"""Long chaos soaks (marked slow; tier-1 runs the smoke suite instead).
+
+The shipped plans stretched to several fault/heal cycles and a longer
+post-heal tail: every invariant must hold across repeated injections,
+and determinism must survive the longer trajectory too."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.chaos import ChaosRunner, get_plan
+from doorman_tpu.chaos.plans import PLANS
+
+pytestmark = pytest.mark.slow
+
+
+def _stretched(name, cycles=3):
+    """Repeat the plan's fault burst `cycles` times, spaced a full
+    heal-plus-reconverge apart, with a long settled tail."""
+    plan = get_plan(name)
+    span = (plan.heal_tick - plan.warmup_ticks) + plan.reconverge_ticks + 4
+    events = []
+    for c in range(cycles):
+        for ev in plan.events:
+            events.append(dataclasses.replace(
+                ev, at_tick=ev.at_tick + c * span
+            ))
+    last_heal = max(ev.at_tick + ev.duration_ticks for ev in events)
+    return dataclasses.replace(
+        plan,
+        events=events,
+        total_ticks=last_heal + plan.reconverge_ticks + 6,
+    )
+
+
+@pytest.mark.parametrize("name", sorted(PLANS))
+def test_soak_repeated_fault_cycles(name):
+    verdict = asyncio.run(ChaosRunner(_stretched(name)).run())
+    assert verdict["violations"] == [], verdict["event_log"]
+    assert verdict["ok"], verdict
+
+
+def test_soak_determinism():
+    plan = _stretched("master_flap")
+    v1 = asyncio.run(ChaosRunner(plan).run())
+    v2 = asyncio.run(ChaosRunner(plan).run())
+    assert v1["log_sha256"] == v2["log_sha256"]

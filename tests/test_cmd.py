@@ -295,3 +295,37 @@ resources:
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
+
+
+def test_chaos_cli_runs_plan(tmp_path):
+    """The chaos entry point: `python -m doorman_tpu.cmd.chaos` lists
+    plans as a real subprocess; the save -> load -> run flow executes a
+    shipped plan from a JSON file and writes a passing verdict."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    from doorman_tpu.cmd import chaos as chaos_cmd
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "-m", "doorman_tpu.cmd.chaos", "--list"],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    assert "master_flap" in out.stdout and "etcd_brownout" in out.stdout
+
+    plan_path = tmp_path / "plan.json"
+    verdict_path = tmp_path / "verdict.json"
+    rc = asyncio.run(chaos_cmd.run(chaos_cmd.make_parser().parse_args(
+        ["--save-plan", "etcd_brownout", str(plan_path)]
+    )))
+    assert rc == 0 and plan_path.exists()
+    rc = asyncio.run(chaos_cmd.run(chaos_cmd.make_parser().parse_args(
+        ["--plan", str(plan_path), "--out", str(verdict_path)]
+    )))
+    assert rc == 0
+    verdict = json.loads(verdict_path.read_text())
+    assert verdict["plan"] == "etcd_brownout"
+    assert verdict["ok"] and verdict["violations"] == []

@@ -322,3 +322,61 @@ def test_boundary_width_exact_multiple():
         assert res.store.sum_has == pytest.approx(
             min(100.0, 20.0 * n), rel=1e-9
         )
+
+
+def test_drain_remove_pack_interleaving_converges():
+    """The documented one-tick UPLOAD inconsistency window: a
+    swap-remove landing between dispatch's slot drain and its
+    pack_slots read pairs the new occupant's wants with the old
+    occupant's device lanes for that solve. The chunk-version guard
+    (read before the pack) must block the skewed chunks' write-back,
+    and the re-marked slots must re-deliver a consistent solve — the
+    stores converge to the batch fixpoint within the following ticks.
+    """
+    t = [200.0]
+    clock = lambda: t[0]
+    eng_a, res_a = make_world(clock, n_res=1, n_clients=21, seed=7)
+    eng_b, res_b = make_world(clock, n_res=1, n_clients=21, seed=7)
+    wide = WideResidentSolver(
+        eng_a, dtype=np.float64, clock=clock, rotate_ticks=1,
+        chunk_width=8,
+    )
+    batch = BatchSolver(dtype=np.float64, clock=clock)
+    wide.step(res_a)
+    batch.tick(res_b)
+    t[0] += 1.0
+
+    # Wants-only churn dirties c0_5's slot (level 1)...
+    old_has = res_a[0].store.get("c0_5").has
+    res_a[0].store.assign("c0_5", 60.0, 5.0, old_has, 777.0, 1)
+
+    # ... and the swap-remove lands BETWEEN the drain and the pack:
+    # the first pack_slots of this dispatch releases c0_5, so the
+    # drained slot index now holds the swapped-in occupant.
+    orig_pack = eng_a.pack_slots
+    fired = []
+
+    def racing_pack(rid, slots):
+        if not fired:
+            fired.append(True)
+            res_a[0].store.release("c0_5")
+        return orig_pack(rid, slots)
+
+    eng_a.pack_slots = racing_pack
+    try:
+        handle = wide.dispatch(res_a)
+    finally:
+        eng_a.pack_slots = orig_pack
+    wide.collect(handle)
+    assert fired, "the interleaved release never raced the pack"
+
+    # Mirror world: same net operations, batch ground truth.
+    old_has_b = res_b[0].store.get("c0_5").has
+    res_b[0].store.assign("c0_5", 60.0, 5.0, old_has_b, 777.0, 1)
+    res_b[0].store.release("c0_5")
+
+    for _ in range(3):
+        t[0] += 1.0
+        wide.step(res_a)
+        batch.tick(res_b)
+    assert_close(all_leases(res_a), all_leases(res_b))
