@@ -46,12 +46,20 @@ import numpy as np
 # outages (round-4 lesson: the measured numbers lived only in prose
 # while BENCH_r04 recorded backend_unreachable).
 _EMITTED: list = []
+_DIAGNOSTICS: list = []
 _PLATFORM_INFO: dict = {}
 
 
-def emit(obj: dict) -> None:
+def emit(obj: dict, artifact_extra: dict = None) -> None:
+    """Print one result line and append it to the artifact.
+    `artifact_extra` rides along in doc/bench_last.json only (bulky
+    payloads like per-tick phase breakdowns stay off stdout, whose last
+    line the driver parses as the headline metric)."""
     print(json.dumps(obj), flush=True)
-    _EMITTED.append(obj)
+    rec = dict(obj)
+    if artifact_extra:
+        rec.update(artifact_extra)
+    _EMITTED.append(rec)
     # Incremental artifact: every emitted result lands on disk
     # IMMEDIATELY, so a mid-run backend outage (the round-5 failure
     # mode: the tunnel died during bench_server_tick_wide and the
@@ -60,6 +68,20 @@ def emit(obj: dict) -> None:
         write_artifact(complete=False)
     except Exception:
         pass  # artifact trouble must never kill a measurement run
+
+
+def diagnostic(obj: dict) -> None:
+    """Report a run-infrastructure condition (backend unreachable, probe
+    failures). Distinct from emit(): a diagnostic is NOT a measurement —
+    it prints and lands in the artifact under "diagnostics", never in
+    "results", so trajectory tooling cannot ingest it as a metric row
+    (the BENCH_r05 {"metric": "backend_unreachable", "value": 0} trap)."""
+    print(json.dumps(obj), flush=True)
+    _DIAGNOSTICS.append(obj)
+    try:
+        write_artifact(complete=False)
+    except Exception:
+        pass
 
 
 def _platform_info() -> dict:
@@ -99,6 +121,9 @@ def write_artifact(complete: bool = True) -> None:
         # mid-run): the results list holds everything emitted so far.
         "complete": complete,
         "results": _EMITTED,
+        # Infrastructure conditions (probe failures etc.) — never
+        # measurements; kept apart so tooling can't mistake them.
+        "diagnostics": _DIAGNOSTICS,
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -135,6 +160,19 @@ def phase_attribution(solver, phase_mark, collects_mark, n_ticks):
         )
         for k, v in solver.phase_s.items()
     }
+
+
+def phase_deltas_ms(samples):
+    """Per-tick phase breakdown (ms) from consecutive cumulative
+    phase_s snapshots — one dict per tick, for the artifact (satisfies
+    'where does THIS tick's time go', not just the window average)."""
+    return [
+        {
+            k: round((cur.get(k, 0.0) - prev.get(k, 0.0)) * 1000.0, 3)
+            for k in cur
+        }
+        for prev, cur in zip(samples, samples[1:])
+    ]
 
 
 def spot_check(wants, has, active, capacity, kind, static_cap, gets):
@@ -444,6 +482,7 @@ def bench_server_tick() -> None:
     handles = []
     phase_mark = {}
     collects_mark = 0
+    phase_samples = [dict(solver.phase_s)]
     for t in range(n_ticks):
         if t == SERVER_WARMUP:
             phase_mark = dict(solver.phase_s)
@@ -457,6 +496,7 @@ def bench_server_tick() -> None:
         t2 = time.perf_counter()
         churn_ms.append((t1 - t0) * 1000.0)
         tick_ms.append((t2 - t0) * 1000.0)
+        phase_samples.append(dict(solver.phase_s))
     t0 = time.perf_counter()
     for h in handles:
         solver.collect(h)
@@ -466,7 +506,7 @@ def bench_server_tick() -> None:
     )
     med = float(np.median(timed))
     # Per-phase attribution (phase_attribution): dispatch = sweep +
-    # drain + pack + config + upload + launch; collect = download +
+    # drain + pack + config + upload + solve; collect = download +
     # apply; churn is the client-write workload applied between ticks
     # (included in the headline number because the reference's
     # per-request decide pays it inline too).
@@ -490,7 +530,13 @@ def bench_server_tick() -> None:
             "pipeline_depth": PIPELINE_DEPTH_SERVER,
             "rotate_ticks": SERVER_ROTATE_TICKS,
             "phase_ms": phases,
-        }
+        },
+        artifact_extra={
+            # Measured window only: one per-phase dict per tick.
+            "phase_ms_per_tick": phase_deltas_ms(phase_samples)[
+                SERVER_WARMUP:
+            ],
+        },
     )
 
 
@@ -595,6 +641,7 @@ def bench_server_tick_wide() -> None:
         handles = []
         phase_mark = {}
         collects_mark = 0
+        phase_samples = [dict(solver.phase_s)]
         for t in range(n_ticks):
             if t == SERVER_WARMUP:
                 phase_mark = dict(solver.phase_s)
@@ -610,6 +657,7 @@ def bench_server_tick_wide() -> None:
             if len(handles) >= PIPELINE_DEPTH_SERVER:
                 solver.collect(handles.pop(0))
             tick_ms.append((time.perf_counter() - t0) * 1000.0)
+            phase_samples.append(dict(solver.phase_s))
         t0 = time.perf_counter()
         for h in handles:
             solver.collect(h)
@@ -635,7 +683,12 @@ def bench_server_tick_wide() -> None:
                 "chunk_rows": solver._R,
                 "rotate_ticks": SERVER_ROTATE_TICKS,
                 "phase_ms": phases,
-            }
+            },
+            artifact_extra={
+                "phase_ms_per_tick": phase_deltas_ms(phase_samples)[
+                    SERVER_WARMUP:
+                ],
+            },
         )
 
 
@@ -789,25 +842,49 @@ def _require_backend() -> None:
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     if reason is not None:
-        print(
-            json.dumps(
-                {
-                    "metric": "backend_unreachable",
-                    "value": 0,
-                    "unit": "error",
-                    "note": reason,
-                }
-            ),
-            flush=True,
+        # A dead backend is a run-infrastructure DIAGNOSTIC, not a
+        # measurement: no "metric"/"value" keys, so trajectory tooling
+        # never ingests it as a data point (the BENCH_r05 lesson).
+        # Platform identity is pinned to "unknown" first — the normal
+        # probe path (jax.devices()) can hang on the very tunnel outage
+        # being reported.
+        _PLATFORM_INFO.update(platform="unknown", device="unknown")
+        diagnostic(
+            {
+                "diagnostic": "backend_unreachable",
+                "rc": 3,
+                "note": reason,
+            }
         )
         os._exit(3)
 
 
 if __name__ == "__main__":
+    import argparse
+
+    from doorman_tpu.obs import trace as _trace_mod
+
+    _ap = argparse.ArgumentParser(description="doorman-tpu benchmarks")
+    _ap.add_argument(
+        "--trace", default="",
+        help="enable the span tracer for the run and write a Chrome "
+             "trace (Perfetto-loadable) of the server-tick benches' "
+             "per-phase spans to this path",
+    )
+    _ap.add_argument(
+        "--jax-trace", default="",
+        help="capture a device-side jax.profiler trace of the headline "
+             "measured solve into this directory (xprof/tensorboard)",
+    )
+    _args = _ap.parse_args()
+    if _args.trace:
+        _trace_mod.default_tracer().enable()
     _require_backend()
     gate_pallas_kernels()
     try:
-        main()
+        # Opt-in device-side timeline around the measured solve.
+        with _trace_mod.jax_capture(_args.jax_trace or None):
+            main()
         bench_server_tick_wide()
         # The narrow server tick stays LAST: the driver parses the final
         # JSON line as the round's headline metric.
@@ -819,3 +896,11 @@ if __name__ == "__main__":
         import sys as _sys
 
         write_artifact(complete=_sys.exc_info()[0] is None)
+        if _args.trace:
+            try:
+                with open(_args.trace, "w") as _f:
+                    _f.write(_trace_mod.default_tracer().chrome_json())
+                print(f"wrote Chrome trace to {_args.trace}",
+                      file=_sys.stderr)
+            except Exception:
+                pass  # trace trouble must never mask the bench outcome

@@ -38,6 +38,7 @@ from doorman_tpu.chaos.invariants import InvariantChecker, Violation
 from doorman_tpu.chaos.plan import FaultPlan
 from doorman_tpu.client.client import Client
 from doorman_tpu.client.connection import Connection
+from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.server.config import parse_yaml_config
 from doorman_tpu.server.election import Election, InMemoryKV, TrivialElection
 from doorman_tpu.server.server import CapacityServer
@@ -145,6 +146,24 @@ class ChaosRunner:
         self.kv: Optional[InMemoryKV] = None
         self.log: List[list] = []
         self.violations: List[Violation] = []
+        # Fault / violation tallies in the default registry, so a chaos
+        # run's damage shows on the same /metrics surface as everything
+        # else (and soaks can assert on them).
+        reg = metrics_mod.default_registry()
+        self._faults_counter = reg.counter(
+            "doorman_chaos_faults_injected",
+            "Fault events applied by the chaos runner, by kind.",
+            labels=("kind",),
+        )
+        self._violations_counter = reg.counter(
+            "doorman_chaos_invariant_violations",
+            "Invariant violations observed by the chaos runner.",
+            labels=("invariant",),
+        )
+
+    def _record_violation(self, v: Violation) -> None:
+        self.violations.append(v)
+        self._violations_counter.inc(v.invariant)
 
     # -- setup ----------------------------------------------------------
 
@@ -259,6 +278,7 @@ class ChaosRunner:
             self.bound_ports.append(self.ports.bind())
         else:
             self.state.start(ev)
+        self._faults_counter.inc(ev.kind)
         self.log.append(
             [tick, "fault", ev.kind, ev.target, ev.duration_ticks]
         )
@@ -331,7 +351,7 @@ class ChaosRunner:
                 for v in checker.check_tick(
                     tick, self.servers, groups, self.clients
                 ):
-                    self.violations.append(v)
+                    self._record_violation(v)
                     self.log.append([tick] + v.as_log())
 
                 if tick == plan.warmup_ticks - 1:
@@ -363,7 +383,7 @@ class ChaosRunner:
             converged_at - heal_tick <= plan.reconverge_ticks
         )
         if converged_at is None and baseline is not None:
-            self.violations.append(Violation(
+            self._record_violation(Violation(
                 plan.total_ticks, "reconvergence", RESOURCE,
                 f"no reconvergence within {plan.total_ticks - heal_tick} "
                 f"post-heal ticks (budget {plan.reconverge_ticks})",
@@ -379,6 +399,9 @@ class ChaosRunner:
             "seed": plan.seed,
             "ok": not self.violations and reconverged,
             "ticks": plan.total_ticks,
+            # For the Chrome-trace export: one virtual tick maps to this
+            # many seconds of trace time (chaos.trace_export).
+            "tick_interval": plan.tick_interval,
             "heal_tick": heal_tick,
             "converged_after_heal_ticks": (
                 None if converged_at is None else converged_at - heal_tick

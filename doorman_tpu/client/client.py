@@ -19,6 +19,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from doorman_tpu.client.connection import Connection
+from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
 
@@ -237,6 +238,16 @@ class Client:
         return retry == 0
 
     async def _perform_requests(self, retry_number: int):
+        # The refresh span is the root of one tick's client-side trace;
+        # the RPC child span's context crosses the gRPC hop as metadata,
+        # making the server's handler span this refresh's descendant.
+        with trace_mod.default_tracer().span(
+            "client.refresh", cat="client",
+            args={"client": self.id, "resources": len(self.resources)},
+        ):
+            return await self._refresh_cycle(retry_number)
+
+    async def _refresh_cycle(self, retry_number: int):
         request = pb.GetCapacityRequest(client_id=self.id)
         for resource_id, res in self.resources.items():
             rr = request.resource.add()
@@ -269,10 +280,19 @@ class Client:
         )
         start = time.monotonic()
         try:
-            out = await asyncio.wait_for(
-                self.conn.execute(lambda stub: stub.GetCapacity(request)),
-                timeout=bound,
-            )
+            # Metadata resolves inside the lambda, per attempt, under
+            # the RPC span — retries re-send the current context.
+            with trace_mod.default_tracer().span(
+                "client.GetCapacity", cat="client"
+            ):
+                out = await asyncio.wait_for(
+                    self.conn.execute(
+                        lambda stub: stub.GetCapacity(
+                            request, metadata=trace_mod.grpc_metadata()
+                        )
+                    ),
+                    timeout=bound,
+                )
             failed = False
         except Exception:
             log.exception("%s: GetCapacity failed", self.id)

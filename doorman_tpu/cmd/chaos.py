@@ -40,6 +40,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="write a shipped plan's JSON to PATH and exit")
     p.add_argument("--out", default="",
                    help="also write the verdict JSON to this path")
+    p.add_argument("--trace", default="",
+                   help="write the run's virtual-time event log as a "
+                        "Chrome trace (open in Perfetto) to this path")
     return p
 
 
@@ -70,6 +73,11 @@ async def run(args: argparse.Namespace) -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.trace:
+        from doorman_tpu.chaos.trace_export import write_chrome_trace
+
+        write_chrome_trace(verdict, args.trace)
+        print(f"wrote Chrome trace to {args.trace}", file=sys.stderr)
     return 0 if verdict["ok"] else 1
 
 

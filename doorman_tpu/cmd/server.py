@@ -21,6 +21,8 @@ from doorman_tpu.obs import (
     DebugServer,
     Registry,
     add_status_part,
+    default_registry,
+    default_tracer,
     instrument_server,
 )
 from doorman_tpu.server import config as config_mod
@@ -69,6 +71,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="batch mode: write a JAX profiler trace of the "
                         "first --profile-ticks ticks to this directory")
     p.add_argument("--profile-ticks", type=int, default=8)
+    p.add_argument("--trace", action="store_true",
+                   help="enable the span tracer: client/server/solver "
+                        "spans land in a ring buffer served at "
+                        "/debug/traces (?format=chrome for Perfetto)")
+    p.add_argument("--trace-buffer", type=int, default=65536,
+                   help="span ring-buffer capacity (with --trace)")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -132,11 +140,20 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
     )
     log.info("serving gRPC on %s:%d", args.host, port)
 
+    if args.trace:
+        default_tracer().enable(capacity=args.trace_buffer)
+        log.info("span tracer enabled (ring %d); see /debug/traces",
+                 args.trace_buffer)
+
     debug = None
     if args.debug_port >= 0:
         # A fresh registry per serve() call: repeated serves in one
-        # process must not accumulate collectors for dead servers.
+        # process must not accumulate collectors for dead servers — but
+        # the process-global default registry (tick-phase histograms,
+        # mastership/chaos counters) is re-exported at scrape time so
+        # /metrics stays one complete surface.
         registry = instrument_server(server, Registry())
+        registry.add_collector(default_registry().metrics)
         debug = DebugServer(port=args.debug_port, registry=registry)
         debug.add_server(server, asyncio.get_running_loop())
         add_status_part(

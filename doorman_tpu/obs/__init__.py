@@ -1,5 +1,7 @@
-"""Observability: metrics registry (Prometheus text exposition) and the
-debug HTTP server with /debug/status, /debug/resources and /metrics.
+"""Observability: metrics registry (Prometheus text exposition), the
+debug HTTP server with /debug/status, /debug/resources, /debug/traces
+and /metrics, and the zero-dependency span tracer (obs.trace) with
+Chrome trace-event export.
 
 Capability parity with the reference's go/status/status.go (composable
 status parts), go/cmd/doorman/resourcez.go (per-lease table), and the
@@ -15,13 +17,16 @@ from doorman_tpu.obs.metrics import (
     instrument_server,
 )
 from doorman_tpu.obs.debug import DebugServer, add_status_part
+from doorman_tpu.obs.trace import Tracer, default_tracer
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
     "Registry",
+    "Tracer",
     "default_registry",
+    "default_tracer",
     "instrument_server",
     "DebugServer",
     "add_status_part",

@@ -1,4 +1,5 @@
-"""Debug HTTP server: /debug/status, /debug/resources, /metrics, /healthz.
+"""Debug HTTP server: /debug/status, /debug/resources, /debug/traces,
+/metrics, /healthz — with a /debug index listing every route.
 
 Capability parity with the reference's composable status page
 (go/status/status.go:129-192 — named template "parts" contributed by any
@@ -23,8 +24,24 @@ from typing import Callable, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from doorman_tpu.obs import metrics as metrics_mod
+from doorman_tpu.obs import trace as trace_mod
 
 __all__ = ["DebugServer", "add_status_part", "status_parts"]
+
+# Every route the handler serves, with a one-line description — the
+# /debug index page renders this, so a new route only needs one entry
+# here to be discoverable.
+ROUTES = (
+    ("/debug/status", "server overview: mastership, resources, config, "
+                      "tick phase totals"),
+    ("/debug/resources", "per-lease tables (?resource=<id> for one)"),
+    ("/debug/requests", "recent RPC samples (?limit=N)"),
+    ("/debug/traces", "span tracer summary; ?format=chrome downloads a "
+                      "Perfetto-loadable trace"),
+    ("/debug/vars", "expvar-style JSON snapshot"),
+    ("/metrics", "Prometheus text exposition"),
+    ("/healthz", "liveness probe"),
+)
 
 _parts_lock = threading.Lock()
 _parts: Dict[str, Callable[[], str]] = {}
@@ -142,7 +159,8 @@ class DebugServer:
                 f"mode: {html.escape(st['mode'])} | "
                 f"backend: {html.escape(st.get('backend') or '(no tick yet)')} | "
                 f"ticks: {st.get('ticks', 0)} "
-                f"(idle: {st.get('idle_ticks', 0)})</p>"
+                f"(idle: {st.get('idle_ticks', 0)}) | "
+                f"last tick: {st.get('last_tick_ms', 0):g} ms</p>"
                 + (
                     "<p>tick phases (total ms): "
                     + html.escape(
@@ -166,12 +184,65 @@ class DebugServer:
             f"<p>uptime: {uptime:.0f}s</p>"
             + "".join(sections)
             + "".join(status_parts())
-            + "<p><a href='/debug/resources'>resources</a> | "
+            + "<p><a href='/debug'>index</a> | "
+            "<a href='/debug/resources'>resources</a> | "
             "<a href='/debug/requests'>requests</a> | "
+            "<a href='/debug/traces'>traces</a> | "
             "<a href='/metrics'>metrics</a> | "
             "<a href='/debug/vars'>vars</a></p>"
         )
         return _PAGE.format(title="/debug/status", body=body)
+
+    def _index_page(self) -> str:
+        rows = "".join(
+            f"<tr><td><a href={path!r}>{html.escape(path)}</a></td>"
+            f"<td>{html.escape(desc)}</td></tr>"
+            for path, desc in ROUTES
+        )
+        return _PAGE.format(
+            title="/debug",
+            body=f"<table><tr><th>route</th><th>what</th></tr>{rows}"
+                 f"</table>",
+        )
+
+    def _traces_page(self) -> str:
+        """Span tracer summary: per-(category, name) counts and totals,
+        leaked (unclosed) spans, and the Chrome-export download link."""
+        tracer = trace_mod.default_tracer()
+        events = tracer.snapshot()
+        by_key: Dict[tuple, List[float]] = {}
+        for ev in events:
+            by_key.setdefault((ev.cat, ev.name), []).append(ev.dur or 0.0)
+        rows = "".join(
+            f"<tr><td>{html.escape(cat or 'default')}</td>"
+            f"<td>{html.escape(name)}</td>"
+            f"<td>{len(durs)}</td>"
+            f"<td>{sum(durs) / 1000.0:.3f}</td>"
+            f"<td>{max(durs) / 1000.0:.3f}</td></tr>"
+            for (cat, name), durs in sorted(by_key.items())
+        )
+        open_spans = tracer.open_spans()
+        leaked = (
+            "<p>open spans: "
+            + html.escape(
+                ", ".join(s.name for s in open_spans) or "(none)"
+            )
+            + "</p>"
+        )
+        state = "enabled" if tracer.enabled else "disabled"
+        body = (
+            f"<p>tracer {state}; {len(events)} spans buffered "
+            f"(ring capacity {tracer.capacity})</p>"
+            + leaked
+            + "<table><tr><th>category</th><th>span</th><th>count</th>"
+            "<th>total ms</th><th>max ms</th></tr>"
+            + rows
+            + "</table>"
+            "<p><a href='/debug/traces?format=chrome'>download Chrome "
+            "trace</a> — open at https://ui.perfetto.dev or "
+            "chrome://tracing</p>"
+        )
+        return _PAGE.format(title="/debug/traces", body=body)
 
     def _requests_page(self, limit: int) -> str:
         """Recent-RPC samples per server (the reference exposes gRPC's
@@ -266,6 +337,17 @@ class DebugServer:
                         )
                     elif url.path in ("/", "/debug/status"):
                         body, ctype = debug._status_page(), "text/html"
+                    elif url.path in ("/debug", "/debug/"):
+                        body, ctype = debug._index_page(), "text/html"
+                    elif url.path == "/debug/traces":
+                        q = parse_qs(url.query)
+                        if q.get("format", [""])[0] == "chrome":
+                            body, ctype = (
+                                trace_mod.default_tracer().chrome_json(),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = debug._traces_page(), "text/html"
                     elif url.path == "/debug/resources":
                         q = parse_qs(url.query)
                         only = q.get("resource", [None])[0]

@@ -235,6 +235,15 @@ class Registry:
             Histogram(name, help_text, labels, buckets)
         )
 
+    def metrics(self) -> List[Metric]:
+        """The registered metric families (live objects). Lets one
+        registry re-export another's families at scrape time:
+        `reg.add_collector(other.metrics)` — cmd/server chains the
+        process-global default registry (tick-phase histograms, chaos
+        counters) into its per-serve registry this way."""
+        with self._lock:
+            return list(self._metrics.values())
+
     def add_collector(
         self, collector: Callable[[], Iterable[Metric]]
     ) -> Callable[[], None]:

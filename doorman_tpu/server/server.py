@@ -20,6 +20,7 @@ solve runs in an executor thread between snapshot boundaries.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import time
 from typing import Awaitable, Callable, Dict, List, Optional
@@ -29,6 +30,8 @@ import grpc
 from doorman_tpu.algorithms import Request
 from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource, algo_kind_for
+from doorman_tpu.obs import metrics as metrics_mod
+from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
 from doorman_tpu.server import config as config_mod
@@ -301,6 +304,18 @@ class CapacityServer(CapacityServicer):
         """Mastership changes wipe all lease state; a fresh master starts in
         learning mode (server.go:438-455)."""
         self.is_master = is_master
+        # Election transitions land on the trace timeline and in the
+        # default registry — a mastership flip explains every gap or
+        # learning-mode plateau around it.
+        trace_mod.default_tracer().instant(
+            "election.transition", cat="election",
+            args={"server": self.id, "is_master": is_master},
+        )
+        metrics_mod.default_registry().counter(
+            "doorman_server_mastership_transitions",
+            "Mastership transitions observed, by the state entered.",
+            labels=("server", "to"),
+        ).inc(self.id, "master" if is_master else "standby")
         if is_master:
             log.info("%s: this server is now the master", self.id)
             self.became_master_at = self._clock()
@@ -524,7 +539,12 @@ class CapacityServer(CapacityServicer):
         driven directly by tests and operational tooling, and a manual
         tick racing the loop's must queue, not corrupt."""
         async with self._tick_lock:
-            await self._tick_once_locked()
+            with trace_mod.default_tracer().span(
+                "server.tick", cat="tick",
+                args={"server": self.id,
+                      "resources": len(self.resources)},
+            ):
+                await self._tick_once_locked()
 
     async def _tick_once_locked(self) -> None:
         if not self.resources:
@@ -615,12 +635,17 @@ class CapacityServer(CapacityServicer):
                     self._resident_wide_handle = None
                     run_tick()
 
-            await loop.run_in_executor(None, resident_or_fallback)
+            # copy_context: executor threads don't inherit contextvars,
+            # and the solver's phase spans must nest under the tick span.
+            ctx = contextvars.copy_context()
+            await loop.run_in_executor(None, ctx.run, resident_or_fallback)
         elif self._native_store:
-            await loop.run_in_executor(None, run_tick)
+            ctx = contextvars.copy_context()
+            await loop.run_in_executor(None, ctx.run, run_tick)
         else:
             snap = solver.prepare(resources)
-            gets = await loop.run_in_executor(None, solver.solve, snap)
+            ctx = contextvars.copy_context()
+            gets = await loop.run_in_executor(None, ctx.run, solver.solve, snap)
             solver.apply(resources, snap, gets, return_grants=False)
         if self._profiling and self._ticks_done >= self.profile_ticks:
             self._stop_profiler()
@@ -671,45 +696,65 @@ class CapacityServer(CapacityServicer):
         out.mastership.CopyFrom(self._mastership())
         return out
 
+    def _rpc_span(self, method: str, context, caller: str):
+        """A handler span, parented to the caller's span when the RPC
+        carried trace metadata (the gRPC hop of the trace context)."""
+        tracer = trace_mod.default_tracer()
+        if not tracer.enabled:
+            return trace_mod.NOOP_SPAN
+        return tracer.span(
+            f"server.{method}", cat="server",
+            parent=trace_mod.parent_from_grpc_context(context),
+            args={"server": self.id, "caller": caller,
+                  "is_master": self.is_master},
+        )
+
     async def GetCapacity(self, request, context):
         start = self._clock()
         out = pb.GetCapacityResponse()
         err = False
-        try:
-            if not self.is_master:
-                out.mastership.CopyFrom(self._mastership())
+        with self._rpc_span("GetCapacity", context, request.client_id):
+            try:
+                if not self.is_master:
+                    out.mastership.CopyFrom(self._mastership())
+                    return out
+                msg = config_mod.validate_get_capacity_request(request)
+                if msg is not None:
+                    err = True
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+                for req in request.resource:
+                    has = req.has.capacity if req.HasField("has") else 0.0
+                    lease, res = self._decide(
+                        req.resource_id,
+                        Request(request.client_id, has, req.wants, 1,
+                                priority=req.priority),
+                    )
+                    resp = out.response.add()
+                    resp.resource_id = req.resource_id
+                    resp.gets.expiry_time = int(lease.expiry)
+                    resp.gets.refresh_interval = int(lease.refresh_interval)
+                    resp.gets.capacity = lease.has
+                    resp.safe_capacity = res.safe_capacity()
                 return out
-            msg = config_mod.validate_get_capacity_request(request)
-            if msg is not None:
-                err = True
-                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
-            for req in request.resource:
-                has = req.has.capacity if req.HasField("has") else 0.0
-                lease, res = self._decide(
-                    req.resource_id,
-                    Request(request.client_id, has, req.wants, 1,
-                            priority=req.priority),
+            finally:
+                self.on_request("GetCapacity", self._clock() - start, err)
+                self.request_log.record(
+                    "GetCapacity", request.client_id,
+                    [r.resource_id for r in request.resource],
+                    sum(r.wants for r in request.resource),
+                    self._clock() - start, err,
                 )
-                resp = out.response.add()
-                resp.resource_id = req.resource_id
-                resp.gets.expiry_time = int(lease.expiry)
-                resp.gets.refresh_interval = int(lease.refresh_interval)
-                resp.gets.capacity = lease.has
-                resp.safe_capacity = res.safe_capacity()
-            return out
-        finally:
-            self.on_request("GetCapacity", self._clock() - start, err)
-            self.request_log.record(
-                "GetCapacity", request.client_id,
-                [r.resource_id for r in request.resource],
-                sum(r.wants for r in request.resource),
-                self._clock() - start, err,
-            )
 
     async def GetServerCapacity(self, request, context):
         start = self._clock()
         out = pb.GetServerCapacityResponse()
         err = False
+        with self._rpc_span("GetServerCapacity", context, request.server_id):
+            return await self._get_server_capacity(
+                request, context, out, start, err
+            )
+
+    async def _get_server_capacity(self, request, context, out, start, err):
         try:
             if not self.is_master:
                 out.mastership.CopyFrom(self._mastership())
@@ -793,6 +838,12 @@ class CapacityServer(CapacityServicer):
         start = self._clock()
         out = pb.ReleaseCapacityResponse()
         err = False
+        with self._rpc_span("ReleaseCapacity", context, request.client_id):
+            return await self._release_capacity(
+                request, context, out, start, err
+            )
+
+    async def _release_capacity(self, request, context, out, start, err):
         try:
             if not self.is_master:
                 out.mastership.CopyFrom(self._mastership())
@@ -941,9 +992,18 @@ class CapacityServer(CapacityServicer):
             )
         request = self._build_server_capacity_request()
         try:
-            out = await self._parent_conn.execute(
-                lambda stub: stub.GetServerCapacity(request)
-            )
+            # The metadata is computed inside the lambda, at call time,
+            # so each attempt carries the parent_refresh span context
+            # over the GetServerCapacity hop.
+            with trace_mod.default_tracer().span(
+                "server.parent_refresh", cat="server",
+                args={"server": self.id, "parent": self.parent_addr},
+            ):
+                out = await self._parent_conn.execute(
+                    lambda stub: stub.GetServerCapacity(
+                        request, metadata=trace_mod.grpc_metadata()
+                    )
+                )
         except Exception:
             log.exception("%s: GetServerCapacity to parent failed", self.id)
             return (
@@ -1016,14 +1076,11 @@ class CapacityServer(CapacityServicer):
                 if self._resident is not None
                 else 0
             ),
-            "tick_phase_total_ms": (  # cumulative since start
-                {
-                    k: round(v * 1000.0, 3)
-                    for k, v in self._resident.phase_s.items()
-                }
-                if self._resident is not None
-                else {}
-            ),
+            "tick_phase_total_ms": {  # cumulative since start
+                k: round(v * 1000.0, 3)
+                for k, v in self._phase_totals().items()
+            },
+            "last_tick_ms": round(self._last_tick_seconds() * 1000.0, 3),
             "resources": {
                 rid: res.status() for rid, res in self.resources.items()
             },
@@ -1033,6 +1090,31 @@ class CapacityServer(CapacityServicer):
                 else ""
             ),
         }
+
+    def _phase_totals(self) -> Dict[str, float]:
+        """Cumulative per-phase seconds across every active solver path;
+        wide/batch keys are prefixed so a mixed config reads unambiguously
+        (the same breakdown /metrics carries as per-phase histograms)."""
+        out: Dict[str, float] = {}
+        if self._resident is not None:
+            out.update(self._resident.phase_s)
+        if self._resident_wide is not None:
+            for k, v in self._resident_wide.phase_s.items():
+                out[f"wide.{k}"] = v
+        if self._solver is not None:
+            for k, v in self._solver.phase_s.items():
+                out[f"batch.{k}"] = v
+        return out
+
+    def _last_tick_seconds(self) -> float:
+        return max(
+            (
+                s.last_tick_seconds
+                for s in (self._solver, self._resident, self._resident_wide)
+                if s is not None
+            ),
+            default=0.0,
+        )
 
     def _backend_platform(self) -> str:
         if self._ticks_done <= 0:

@@ -24,6 +24,7 @@ from dataclasses import dataclass
 
 from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
+from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.core.snapshot import (
     ResourceSpec,
     Snapshot,
@@ -176,6 +177,13 @@ class BatchSolver:
         self.ticks = 0
         self.last_tick_seconds = 0.0
         self._tick_start = 0.0
+        # Cumulative per-phase wall time (seconds); every phase also
+        # lands in the default metrics registry and the trace ring
+        # (obs.phases.PhaseRecorder). Keys pre-created so concurrent
+        # readers can iterate while a tick updates values.
+        self.phase_s: Dict[str, float] = {
+            name: 0.0 for name in ("pack", "solve", "apply")
+        }
 
     def set_groups(self, group_caps: Dict[str, float]) -> None:
         """Install the config's capacity groups (name -> shared cap);
@@ -437,14 +445,24 @@ class BatchSolver:
         """Phase 1 (host, must run in the thread that owns the stores):
         sweep expired leases and pack the snapshot."""
         self._tick_start = self._clock()
+        ph = PhaseRecorder("batch", self.phase_s)
         res_list = list(resources)
         for r in res_list:
             r.store.clean()
-        return self.snapshot(res_list)
+        snap = self.snapshot(res_list)
+        ph.lap("pack")
+        return snap
 
     def solve(self, snap: Snapshot) -> np.ndarray:
         """Phase 2 (device; blocking — safe to run in an executor thread,
         touches no host store state)."""
+        ph = PhaseRecorder("batch", self.phase_s)
+        try:
+            return self._solve_timed(snap)
+        finally:
+            ph.lap("solve")
+
+    def _solve_timed(self, snap: Snapshot) -> np.ndarray:
         part = snap.priority_part
         if part is not None:
             from doorman_tpu.solver.priority import solve_priority
@@ -501,6 +519,7 @@ class BatchSolver:
         `return_grants=False` skips materializing the per-client grant
         map — the tick loop only needs the store side effects, and at
         100k+ leases the map rebuild is per-edge Python work."""
+        ph = PhaseRecorder("batch", self.phase_s)
         by_id = {r.id: r for r in resources}
         if snap.engine is not None:
             out = self._apply_native(
@@ -533,6 +552,7 @@ class BatchSolver:
                 if return_grants:
                     out.setdefault(resource_id, {})[client_id] = grant
         self._apply_priority_part(by_id, snap, out, return_grants)
+        ph.lap("apply")
         self.ticks += 1
         self.last_tick_seconds = self._clock() - self._tick_start
         return out

@@ -55,6 +55,7 @@ import numpy as np
 
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.core.snapshot import _bucket
+from doorman_tpu.obs.phases import PhaseRecorder
 
 # Dense row padding (shared rule with solver.batch._round_rows).
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
@@ -144,15 +145,17 @@ class ResidentDenseSolver:
         self.last_tick_seconds = 0.0
         self._quiet_ticks = 0
         # Per-phase wall-time accumulators (seconds) for the perf
-        # breakdown; bench.py reports them per tick. All keys exist from
-        # construction so readers (e.g. /debug/status on the event loop)
-        # can iterate while a tick in an executor thread updates values
-        # — the dict never resizes, only stores floats.
+        # breakdown; bench.py reports them per tick, and every lap also
+        # lands in the default metrics registry and the trace ring
+        # (obs.phases.PhaseRecorder). All keys exist from construction
+        # so readers (e.g. /debug/status on the event loop) can iterate
+        # while a tick in an executor thread updates values — the dict
+        # never resizes, only stores floats.
         self.phase_s: Dict[str, float] = {
             name: 0.0
             for name in (
-                "sweep", "drain", "config", "pack", "upload", "launch",
-                "download", "apply",
+                "sweep", "drain", "config", "pack", "upload", "solve",
+                "download", "apply", "rebuild",
             )
         }
 
@@ -422,14 +425,8 @@ class ResidentDenseSolver:
         `config_epoch`: bump whenever templates / learning windows /
         parent leases changed outside the store (config reload,
         mastership change) — template reads are cached against it."""
-        t0 = time.perf_counter()
-        ph = self.phase_s
-
-        def lap(name):
-            nonlocal t0
-            t1 = time.perf_counter()
-            ph[name] = ph.get(name, 0.0) + (t1 - t0)
-            t0 = t1
+        ph = PhaseRecorder("resident", self.phase_s)
+        lap = ph.lap
 
         now = self._clock()
         self._engine.clean_all(now)
@@ -437,7 +434,7 @@ class ResidentDenseSolver:
         res_list = list(resources)
         if self._wants is None or self._rows_changed(res_list):
             self.rebuild(res_list)
-            t0 = time.perf_counter()  # rebuilds are rare; keep laps clean
+            lap("rebuild")  # rebuilds are rare; timed as their own phase
 
         dirty_rids, full_flags = self._engine.drain_dirty2()
         if len(dirty_rids):
@@ -593,7 +590,11 @@ class ResidentDenseSolver:
         from doorman_tpu.utils.transfer import start_download
 
         out = start_download(out)
-        lap("launch")
+        # "solve": the jitted tick call + download kickoff. On the CPU
+        # backend this is the synchronous device solve; on TPU it is
+        # the (async) launch of it — the device-side time shows in the
+        # JAX profiler capture, not here.
+        lap("solve")
         return TickHandle(
             out=out,
             sel_rows=sel,
@@ -620,24 +621,19 @@ class ResidentDenseSolver:
             self.idle_ticks += 1
             self.last_tick_seconds = self._clock() - handle.dispatched_at
             return 0
-        t0 = time.perf_counter()
+        ph = PhaseRecorder("resident", self.phase_s)
         # Parts were split (and their async copies started) at
         # dispatch; land them in order into one buffer.
         gets = land_parts(handle.out)
         gets = np.asarray(gets, np.float64)[: handle.n_sel]
-        t1 = time.perf_counter()
-        self.phase_s["download"] = (
-            self.phase_s.get("download", 0.0) + (t1 - t0)
-        )
+        ph.lap("download")
         applied = self._engine.apply_dense(
             handle.rids,
             gets,
             handle.keep_has,
             handle.versions,
         )
-        self.phase_s["apply"] = (
-            self.phase_s.get("apply", 0.0) + (time.perf_counter() - t1)
-        )
+        ph.lap("apply")
         self.ticks += 1
         self.last_tick_seconds = self._clock() - handle.dispatched_at
         return applied

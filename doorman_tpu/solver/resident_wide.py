@@ -60,6 +60,7 @@ import numpy as np
 
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.core.snapshot import _bucket
+from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
 from doorman_tpu.solver.resident import TickHandle, _ceil_to
 
@@ -110,8 +111,8 @@ class WideResidentSolver:
         self.phase_s: Dict[str, float] = {
             name: 0.0
             for name in (
-                "sweep", "drain", "config", "pack", "upload", "launch",
-                "download", "apply",
+                "sweep", "drain", "config", "pack", "upload", "solve",
+                "download", "apply", "rebuild",
             )
         }
 
@@ -353,14 +354,8 @@ class WideResidentSolver:
         """Host+device phase: sweep, drain dirty slots, upload the
         deltas, launch the solve, start the delivery download. Safe to
         run in an executor thread (the engine is mutex-guarded)."""
-        t0 = time.perf_counter()
-        ph = self.phase_s
-
-        def lap(name):
-            nonlocal t0
-            t1 = time.perf_counter()
-            ph[name] = ph.get(name, 0.0) + (t1 - t0)
-            t0 = t1
+        ph = PhaseRecorder("resident_wide", self.phase_s)
+        lap = ph.lap
 
         now = self._clock()
         self._engine.clean_all(now)
@@ -368,7 +363,7 @@ class WideResidentSolver:
         res_list = list(resources)
         if self._wants is None or self._needs_rebuild(res_list):
             self.rebuild(res_list)
-            t0 = time.perf_counter()
+            lap("rebuild")
 
         # Drain dirty slots of our tracked rids. (drain FIRST, then
         # read versions, then pack — see StoreEngine.chunk_versions.)
@@ -534,7 +529,7 @@ class WideResidentSolver:
         from doorman_tpu.utils.transfer import start_download
 
         out = start_download(out)
-        lap("launch")
+        lap("solve")
         keep = np.zeros(n_sel, np.uint8)
         if n_sel:
             segs = self._row_seg_h[sel]
@@ -564,11 +559,10 @@ class WideResidentSolver:
             self.idle_ticks += 1
             self.last_tick_seconds = self._clock() - handle.dispatched_at
             return 0
-        t0 = time.perf_counter()
+        ph = PhaseRecorder("resident_wide", self.phase_s)
         gets = land_parts(handle.out)
         gets = np.asarray(gets, np.float64)[: handle.n_sel]
-        t1 = time.perf_counter()
-        self.phase_s["download"] += t1 - t0
+        ph.lap("download")
         applied = self._engine.apply_chunks(
             handle.rids,
             handle.chunks,
@@ -576,7 +570,7 @@ class WideResidentSolver:
             handle.keep_has,
             handle.versions,
         )
-        self.phase_s["apply"] += time.perf_counter() - t1
+        ph.lap("apply")
         self.ticks += 1
         self.last_tick_seconds = self._clock() - handle.dispatched_at
         return applied

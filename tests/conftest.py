@@ -26,3 +26,8 @@ def pytest_configure(config):
         "markers",
         "slow: long soaks excluded from tier-1 (deselected by -m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: micro-benchmark assertions (loose budgets; run in tier-1 "
+        "to keep instrumentation overhead honest)",
+    )

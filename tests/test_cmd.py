@@ -186,6 +186,7 @@ def test_debug_port_serves_metrics(tmp_path):
                 "--debug-port", "0",
                 "--config", f"file:{config_path}",
                 "--server-id", "cmd-debug-test",
+                "--trace",
             ]
         )
         task, _, debug = await _start_serve(args)
@@ -200,12 +201,23 @@ def test_debug_port_serves_metrics(tmp_path):
         loop = asyncio.get_running_loop()
         text = await loop.run_in_executor(None, fetch, "/metrics")
         assert "doorman_server_is_master" in text
+        # The per-serve registry re-exports the process-global default
+        # registry (mastership transitions land there).
+        assert "doorman_server_mastership_transitions" in text
         page = await loop.run_in_executor(None, fetch, "/debug/status")
         assert "cmd-debug-test" in page
+        index = await loop.run_in_executor(None, fetch, "/debug")
+        assert "/debug/traces" in index
+        traces = await loop.run_in_executor(None, fetch, "/debug/traces")
+        assert "tracer enabled" in traces
 
         await _stop(task)
 
     asyncio.run(body())
+    from doorman_tpu.obs import trace as trace_mod
+
+    trace_mod.default_tracer().disable()
+    trace_mod.default_tracer().clear()
 
 def test_server_jax_platform_flag_pins_backend(tmp_path):
     """--jax-platform spawns a real server process pinned to the named
@@ -322,10 +334,17 @@ def test_chaos_cli_runs_plan(tmp_path):
         ["--save-plan", "etcd_brownout", str(plan_path)]
     )))
     assert rc == 0 and plan_path.exists()
+    trace_path = tmp_path / "trace.json"
     rc = asyncio.run(chaos_cmd.run(chaos_cmd.make_parser().parse_args(
-        ["--plan", str(plan_path), "--out", str(verdict_path)]
+        ["--plan", str(plan_path), "--out", str(verdict_path),
+         "--trace", str(trace_path)]
     )))
     assert rc == 0
     verdict = json.loads(verdict_path.read_text())
     assert verdict["plan"] == "etcd_brownout"
     assert verdict["ok"] and verdict["violations"] == []
+    # --trace writes the run's virtual-time event log as a Chrome trace
+    # (the same format obs.trace exports), loadable in Perfetto.
+    trace = json.loads(trace_path.read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert any(n.startswith("kv_drop") for n in names), names
