@@ -17,6 +17,7 @@ Package layout:
     parallel/     mesh + shard_map sharded solves (client axis, 2-level tree)
     core/         lease store, resource registry, snapshots
     server/       the capacity server (4 RPCs), config, election
+    persist/      durable lease-state snapshots + journal; warm takeover
     client/       master-aware connection + refresh-loop client
     ratelimiter/  QPS + adaptive rate limiters
     metrics/      prometheus + /debug/status + /debug/resources

@@ -21,6 +21,14 @@ Four families, each with its documented slack:
   * reconvergence (checked by the runner): after the plan heals, client
     allocations return to the fault-free baseline within the plan's
     reconverge budget.
+  * warm restore (persistence-enabled plans): every master takeover that
+    restored state must land capacity-safe — per restored resource,
+    sum(restored grants) <= the live capacity at restore (no learning
+    slack here: the clamp in persist/restore.py is unconditional) — and
+    a resource the restore reported as learning-skipped must actually be
+    OUT of learning mode (a skip that silently fell back to learning
+    would pass the capacity checks while eating the whole cold-path
+    window the plan's reconverge budget excludes).
 """
 
 from __future__ import annotations
@@ -66,6 +74,9 @@ class InvariantChecker:
         # (server, resource, client) -> last seen expiry (monotonicity)
         self._expiries: Dict[Tuple[str, str, str], float] = {}
         self._expiry_regressions: List = []
+        # Restore summaries already validated (by object identity: a
+        # server keeps one summary per takeover).
+        self._checked_restores: set = set()
 
     # -- per-tick entry point ------------------------------------------
 
@@ -79,8 +90,41 @@ class InvariantChecker:
         out: List[Violation] = []
         out += self._check_single_master(tick, servers, election_groups)
         out += self._check_capacity(tick, servers)
+        out += self._check_restores(tick, servers)
         self._record_grants(servers)
         out += self._check_lag_never_lead(tick, clients)
+        return out
+
+    # -- warm restore ---------------------------------------------------
+
+    def _check_restores(self, tick, servers) -> List[Violation]:
+        out = []
+        for name, server in servers.items():
+            summary = getattr(server, "last_restore", None)
+            if summary is None or id(summary) in self._checked_restores:
+                continue
+            self._checked_restores.add(id(summary))
+            for rid, info in summary.get("resources", {}).items():
+                if (
+                    info["capacity"] > 0
+                    and info["sum_has"] > info["capacity"] + EPS
+                ):
+                    out.append(Violation(
+                        tick, "restore_capacity", f"{name}/{rid}",
+                        f"restored sum(grants)={info['sum_has']:.6f} > "
+                        f"capacity={info['capacity']:.6f}",
+                    ))
+                res = server.resources.get(rid)
+                if (
+                    info["learning"] == "skip"
+                    and res is not None
+                    and res.in_learning_mode
+                ):
+                    out.append(Violation(
+                        tick, "warm_learning", f"{name}/{rid}",
+                        "restore reported learning skipped but the "
+                        "resource is in learning mode",
+                    ))
         return out
 
     # -- single master --------------------------------------------------

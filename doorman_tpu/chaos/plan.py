@@ -80,6 +80,9 @@ class FaultPlan:
     #   lease_length/refresh_interval/learning_mode_duration: seconds
     #   election_ttl: float     virtual seconds
     #   intermediate: bool      add an intermediate hop clients attach to
+    #   persist: bool           shared snapshot+journal backend across
+    #                           the candidates (warm master takeover)
+    #   snapshot_interval: float  virtual seconds between snapshots
     setup: Dict
     events: List[FaultEvent] = field(default_factory=list)
     warmup_ticks: int = 5      # fault-free ticks before the first event;

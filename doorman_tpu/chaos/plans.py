@@ -144,8 +144,51 @@ def intermediate_partition() -> FaultPlan:
     )
 
 
+def master_flap_warm() -> FaultPlan:
+    """master_flap with persistence enabled (a shared snapshot+journal
+    backend): the master's etcd view browns out past the lock TTL, it
+    steps down CLEANLY (terminal journal marker), and the standby that
+    wins the lock restores the full lease table instead of relearning.
+    Expect: a `restore` event with mode=warm and a complete journal,
+    learning mode skipped for the restored resource (the cold path
+    would relearn for `learning_mode_duration` = 10 ticks), restored
+    grants never above capacity (the `restore_capacity` invariant), and
+    reconvergence within 2 ticks of the heal — the budget that makes
+    warm takeover observable: it is 1/5th of the learning window the
+    cold path would need before serving real grants again."""
+    return FaultPlan(
+        name="master_flap_warm",
+        seed=5,
+        setup={
+            "servers": 2,
+            "clients": 3,
+            "wants": [20.0, 30.0, 60.0],
+            "capacity": 100,
+            "mode": "immediate",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            # Long enough that a cold takeover visibly eats the plan's
+            # reconvergence budget; the warm path must not need it.
+            "learning_mode_duration": 10,
+            "election_ttl": 3.0,
+            "persist": True,
+            "snapshot_interval": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=13, kind="kv_drop", target="s0",
+                       duration_ticks=5),
+        ],
+        # The initial (cold, empty-backend) learning window is 10 ticks;
+        # the baseline snapshot must land after it.
+        warmup_ticks=13,
+        total_ticks=26,
+        reconverge_ticks=2,
+    )
+
+
 PLANS: Dict[str, "callable"] = {
     "master_flap": master_flap,
+    "master_flap_warm": master_flap_warm,
     "etcd_brownout": etcd_brownout,
     "device_tunnel_outage": device_tunnel_outage,
     "intermediate_partition": intermediate_partition,

@@ -144,6 +144,12 @@ class ChaosRunner:
         self.elections: Dict[str, SteppedElection] = {}
         self.clients: List[Client] = []
         self.kv: Optional[InMemoryKV] = None
+        # Shared persistence backend (setup["persist"]): every election
+        # candidate snapshots/journals to the SAME store, modeling the
+        # shared filesystem / etcd prefix a real warm-takeover
+        # deployment needs.
+        self.persist_backend = None
+        self._logged_restores: set = set()
         self.log: List[list] = []
         self.violations: List[Violation] = []
         # Fault / violation tallies in the default registry, so a chaos
@@ -188,6 +194,10 @@ class ChaosRunner:
         s = self.plan.setup
         self.kv = InMemoryKV(clock=self.clock)
         config = parse_yaml_config(self._config_yaml())
+        if s.get("persist"):
+            from doorman_tpu.persist.backend import MemoryBackend
+
+            self.persist_backend = MemoryBackend()
         for i in range(int(s.get("servers", 1))):
             name = f"s{i}"
             proxy = ChaosGrpcProxy(self.state, link=f"link:{name}")
@@ -197,6 +207,18 @@ class ChaosRunner:
                 LOCK, ttl=float(s.get("election_ttl", 3.0)),
                 clock=self.clock,
             )
+            persist = None
+            if self.persist_backend is not None:
+                from doorman_tpu.persist import PersistManager
+
+                persist = PersistManager(
+                    self.persist_backend,
+                    snapshot_interval=float(
+                        s.get("snapshot_interval", 3.0)
+                    ),
+                    flush_interval=self.plan.tick_interval,
+                    clock=self.clock,
+                )
             server = CapacityServer(
                 proxy.address, election,
                 mode=s.get("mode", "immediate"),
@@ -204,6 +226,7 @@ class ChaosRunner:
                 minimum_refresh_interval=0.0,
                 clock=self.clock,
                 native_store=bool(s.get("native_store", False)),
+                persist=persist,
             )
             SolverInjector(self.state, name).install(server)
             await server.start(0, host="127.0.0.1")
@@ -283,6 +306,25 @@ class ChaosRunner:
             [tick, "fault", ev.kind, ev.target, ev.duration_ticks]
         )
 
+    def _log_restores(self, tick: int) -> None:
+        """Surface each master-takeover restore in the event log (once
+        per summary object), keeping the entry deterministic: mode,
+        lease count, journal completeness and learning outcomes only —
+        no wall-clock or backend specifics."""
+        for name, server in self.servers.items():
+            lr = getattr(server, "last_restore", None)
+            if lr is None or id(lr) in self._logged_restores:
+                continue
+            self._logged_restores.add(id(lr))
+            learning = sorted(
+                [rid, info["learning"]]
+                for rid, info in lr.get("resources", {}).items()
+            )
+            self.log.append([
+                tick, "restore", name, lr["mode"],
+                lr["leases_restored"], bool(lr["clean_down"]), learning,
+            ])
+
     def _snapshot(self) -> Dict[str, float]:
         return {
             f"{cl.id}/{rid}": res.current_capacity()
@@ -321,6 +363,7 @@ class ChaosRunner:
 
                 for election in self.elections.values():
                     await election.step()
+                self._log_restores(tick)
                 masters = tuple(sorted(
                     n for n, srv in self.servers.items()
                     if n != "inter" and srv.is_master
@@ -347,6 +390,13 @@ class ChaosRunner:
 
                 for client in self.clients:
                     await client.refresh_once()
+
+                # The durability beat (journal flush + cadenced
+                # snapshot) runs AFTER the tick's refreshes so this
+                # tick's decides are on disk before the next tick — the
+                # freshness bound warm takeover leans on.
+                for server in self.servers.values():
+                    server.persist_step()
 
                 for v in checker.check_tick(
                     tick, self.servers, groups, self.clients

@@ -77,6 +77,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "/debug/traces (?format=chrome for Perfetto)")
     p.add_argument("--trace-buffer", type=int, default=65536,
                    help="span ring-buffer capacity (with --trace)")
+    p.add_argument("--persist", default="",
+                   help="durable lease-state snapshots + journal for "
+                        "warm master takeover: 'file:<dir>' (shared "
+                        "storage for cross-machine takeover) or "
+                        "'etcd:<key-prefix>' (chunked keys via "
+                        "--etcd-endpoints); empty disables (cold "
+                        "wipe-and-relearn takeovers)")
+    p.add_argument("--snapshot-interval", type=float, default=30.0,
+                   help="seconds between full state snapshots (journal "
+                        "deltas cover the gaps)")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -116,6 +126,18 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
     else:
         election = TrivialElection()
 
+    persist = None
+    if args.persist:
+        from doorman_tpu.persist import PersistManager, parse_backend
+
+        persist = PersistManager(
+            parse_backend(args.persist, etcd_endpoints=etcd_endpoints),
+            snapshot_interval=args.snapshot_interval,
+            flush_interval=min(args.tick_interval, 1.0),
+        )
+        log.info("persistence enabled: %s (snapshot every %.1fs)",
+                 args.persist, args.snapshot_interval)
+
     server_id = args.server_id or f"{args.host}:{args.port}"
     server = CapacityServer(
         server_id,
@@ -130,6 +152,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         profile_dir=args.profile_dir or None,
         profile_ticks=args.profile_ticks,
         solver_dtype=args.solver_dtype,
+        persist=persist,
     )
 
     port = await server.start(

@@ -134,6 +134,30 @@ class LeaseStore:
             self.release(client)
         return len(expired)
 
+    def restore(self, client: str, lease: Lease) -> None:
+        """Insert a lease verbatim — absolute expiry preserved — for the
+        persistence restore path (doorman_tpu/persist). assign() would
+        re-stamp expiry from the clock, silently extending every
+        restored lease by a full lease length."""
+        old = self._leases.get(client, ZERO_LEASE)
+        self._sum_has += lease.has - old.has
+        self._sum_wants += lease.wants - old.wants
+        self._count += lease.subclients - old.subclients
+        self._leases[client] = lease
+
+    def dump_rows(self) -> List[Tuple[str, float, float, float, float, int, int]]:
+        """Drain API for snapshotting: every lease as one
+        (client, expiry, refresh_interval, has, wants, subclients,
+        priority) row. The native store implements the same contract as
+        a single bulk C call (dm_dump), so snapshot serialization never
+        walks a million-lease store lease-by-lease through Python
+        attribute access."""
+        return [
+            (c, l.expiry, l.refresh_interval, l.has, l.wants,
+             l.subclients, l.priority)
+            for c, l in self._leases.items()
+        ]
+
     def items(self) -> Iterator[Tuple[str, Lease]]:
         return iter(self._leases.items())
 

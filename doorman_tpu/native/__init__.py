@@ -809,6 +809,28 @@ class NativeLeaseStore:
         return (cids[:n], expiry[:n], refresh[:n], has[:n], wants[:n],
                 sub[:n], prio[:n])
 
+    def restore(self, client: str, lease: Lease) -> None:
+        """Insert a lease verbatim (absolute expiry preserved) — the
+        persistence restore path; see core.store.LeaseStore.restore.
+        Bulk restores go through StoreEngine.bulk_assign instead."""
+        self._lib.dm_assign(
+            self._ptr, self._rid, self._engine.client_handle(client),
+            lease.expiry, lease.refresh_interval, lease.has, lease.wants,
+            lease.subclients, lease.priority,
+        )
+
+    def dump_rows(self) -> "list[tuple[str, float, float, float, float, int, int]]":
+        """Drain API for snapshotting (see core.store.LeaseStore
+        .dump_rows): one bulk C call, then name resolution through the
+        engine's interning table."""
+        cids, expiry, refresh, has, wants, sub, prio = self._dump()
+        name = self._engine.client_name
+        return [
+            (name(int(cids[i])), float(expiry[i]), float(refresh[i]),
+             float(has[i]), float(wants[i]), int(sub[i]), int(prio[i]))
+            for i in range(len(cids))
+        ]
+
     def items(self) -> Iterator[Tuple[str, Lease]]:
         cids, expiry, refresh, has, wants, sub, prio = self._dump()
         name = self._engine.client_name

@@ -22,8 +22,9 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from doorman_tpu.parallel.compat import shard_map
 
 from doorman_tpu.solver.kernels import EdgeBatch, ResourceBatch, solve_edges
 

@@ -22,13 +22,27 @@ import base64
 import json
 import time
 import urllib.request
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 
 def _b64(data: "str | bytes") -> str:
     if isinstance(data, str):
         data = data.encode()
     return base64.b64encode(data).decode()
+
+
+def prefix_range_end(prefix: "str | bytes") -> bytes:
+    """The exclusive range end covering every key under `prefix` (the
+    etcd v3 prefix idiom: last byte + 1, trimming trailing 0xff)."""
+    if isinstance(prefix, str):
+        prefix = prefix.encode()
+    end = bytearray(prefix)
+    while end:
+        if end[-1] < 0xFF:
+            end[-1] += 1
+            return bytes(end)
+        end.pop()
+    return b"\x00"  # prefix was all 0xff: range to the keyspace end
 
 
 class EtcdGateway:
@@ -97,6 +111,44 @@ class EtcdGateway:
         if lease_id:
             payload["lease"] = str(lease_id)
         self._post("/v3/kv/put", payload, timeout)
+
+    def get_prefix(
+        self, prefix: str, timeout: float = 30.0
+    ) -> List[Tuple[str, bytes]]:
+        """All (key, value) pairs under `prefix`, key-sorted (the v3
+        range read the persistence backend's chunked journal uses)."""
+        out = self._post(
+            "/v3/kv/range",
+            {
+                "key": _b64(prefix),
+                "range_end": _b64(prefix_range_end(prefix)),
+                # Server default caps a range at its page size; the
+                # snapshot/journal keyspace is pruned to stay well under
+                # any realistic page, but ask for no cap explicitly.
+                "limit": "0",
+            },
+            timeout,
+        )
+        pairs = [
+            (
+                base64.b64decode(kv["key"]).decode(),
+                base64.b64decode(kv.get("value", "")),
+            )
+            for kv in out.get("kvs", [])
+        ]
+        return sorted(pairs)
+
+    def delete_prefix(self, prefix: str, timeout: float = 30.0) -> int:
+        """Delete every key under `prefix`; returns the deleted count."""
+        out = self._post(
+            "/v3/kv/deleterange",
+            {
+                "key": _b64(prefix),
+                "range_end": _b64(prefix_range_end(prefix)),
+            },
+            timeout,
+        )
+        return int(out.get("deleted", 0))
 
     def put_if_absent(
         self,

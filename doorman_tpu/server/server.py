@@ -99,6 +99,7 @@ class CapacityServer(CapacityServicer):
         profile_dir: Optional[str] = None,
         profile_ticks: int = 8,
         solver_dtype: str = "f64",
+        persist=None,  # Optional[doorman_tpu.persist.PersistManager]
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -135,6 +136,16 @@ class CapacityServer(CapacityServicer):
         self._last_band_sweep = 0.0
         self.is_master = False
         self.became_master_at: float = 0.0
+        # Durable lease-state snapshots + journal (doorman_tpu.persist);
+        # None keeps the reference's wipe-and-relearn behavior. The
+        # request path journals every decide/release, the tick pipeline
+        # (or the immediate-mode timer loop) flushes and snapshots, and
+        # _on_is_master(True) restores for a warm takeover.
+        self._persist = persist
+        # Summary dict of the last takeover restore (status pages and
+        # the chaos warm-takeover invariants read it); None when this
+        # server never restored or is not master.
+        self.last_restore: Optional[dict] = None
         self.current_master = ""
         self.config: Optional[pb.ResourceRepository] = None
         self.is_configured = asyncio.Event()
@@ -228,6 +239,10 @@ class CapacityServer(CapacityServicer):
 
         if self.mode == "batch":
             self._tasks.append(asyncio.create_task(self._tick_loop()))
+        elif self._persist is not None:
+            # Batch servers flush/snapshot from the tick pipeline; an
+            # immediate-mode server needs its own durability beat.
+            self._tasks.append(asyncio.create_task(self._persist_loop()))
         return self.port
 
     async def stop(self) -> None:
@@ -302,7 +317,11 @@ class CapacityServer(CapacityServicer):
 
     async def _on_is_master(self, is_master: bool) -> None:
         """Mastership changes wipe all lease state; a fresh master starts in
-        learning mode (server.go:438-455)."""
+        learning mode (server.go:438-455) — unless persistence is
+        configured, in which case the wiped state is rebuilt from the
+        last snapshot + journal and learning mode is skipped or
+        shortened per-resource (doorman_tpu.persist.restore)."""
+        was_master = self.is_master
         self.is_master = is_master
         # Election transitions land on the trace timeline and in the
         # default registry — a mastership flip explains every gap or
@@ -322,6 +341,11 @@ class CapacityServer(CapacityServicer):
         else:
             log.warning("%s: this server lost mastership", self.id)
             self.became_master_at = 0.0
+            if was_master and self._persist is not None:
+                # Flush the terminal step-down marker BEFORE the state
+                # wipe: it certifies the journal as complete, which is
+                # what lets the next master skip learning outright.
+                self._persist.note_step_down()
         self.resources = {}
         self._server_bands = {}
         self._reset_store_engine()
@@ -333,6 +357,14 @@ class CapacityServer(CapacityServicer):
         self._resident_wide = None
         self._resident_wide_handle = None
         self._resident_ok_key = None
+        self.last_restore = None
+        if is_master and self._persist is not None and self.config is not None:
+            # Warm takeover: rebuild the just-wiped state from the
+            # backend. Synchronous on the event loop — nothing serves
+            # concurrently with the rebuild, which is the atomicity the
+            # restore needs; any corruption degrades to the cold path
+            # inside restore().
+            self.last_restore = self._persist.restore(self).as_dict()
 
     async def _on_current_master(self, master: str) -> None:
         if master != self.current_master:
@@ -545,6 +577,10 @@ class CapacityServer(CapacityServicer):
                       "resources": len(self.resources)},
             ):
                 await self._tick_once_locked()
+                # The tick pipeline is the batch server's durability
+                # beat: flush this tick's journal deltas and take the
+                # cadenced snapshot inside the tick span.
+                self.persist_step()
 
     async def _tick_once_locked(self) -> None:
         if not self.resources:
@@ -665,6 +701,26 @@ class CapacityServer(CapacityServicer):
         self._profiling = False
         self._profile_done = True
 
+    def persist_step(self) -> None:
+        """One durability beat (journal flush + cadenced snapshot +
+        compaction) when persistence is configured and this server is
+        master. Driven by the batch tick, the immediate-mode timer
+        loop, or the chaos runner's stepped schedule. A dead backend
+        must never take down serving — failures log and the next beat
+        retries."""
+        if self._persist is None or not self.is_master:
+            return
+        try:
+            self._persist.step(self)
+        except Exception:
+            log.exception("%s: persistence step failed", self.id)
+
+    async def _persist_loop(self) -> None:
+        interval = self._persist.flush_interval
+        while True:
+            await asyncio.sleep(interval)
+            self.persist_step()
+
     async def _tick_loop(self) -> None:
         while True:
             await asyncio.sleep(self.tick_interval)
@@ -782,7 +838,10 @@ class CapacityServer(CapacityServicer):
                 key = (req.resource_id, request.server_id)
                 prios = {band.priority for band in bands}
                 for stale in self._server_bands.get(key, set()) - prios:
-                    res.release(_band_key(request.server_id, stale))
+                    bkey = _band_key(request.server_id, stale)
+                    res.release(bkey)
+                    if self._persist is not None:
+                        self._persist.record_release(req.resource_id, bkey)
                 self._server_bands[key] = prios
                 granted, lease = 0.0, None
                 for band in bands:
@@ -857,11 +916,18 @@ class CapacityServer(CapacityServicer):
                 if res is None:
                     continue
                 res.release(request.client_id)
+                if self._persist is not None:
+                    self._persist.record_release(
+                        resource_id, request.client_id
+                    )
                 # A downstream *server* holds per-band sub-leases; release
                 # them too and forget its band composition.
                 key = (resource_id, request.client_id)
                 for prio in self._server_bands.pop(key, set()):
-                    res.release(_band_key(request.client_id, prio))
+                    bkey = _band_key(request.client_id, prio)
+                    res.release(bkey)
+                    if self._persist is not None:
+                        self._persist.record_release(resource_id, bkey)
             return out
         finally:
             self.on_request("ReleaseCapacity", self._clock() - start, err)
@@ -904,6 +970,7 @@ class CapacityServer(CapacityServicer):
         algorithm; batch mode serves the last tick's solved grant and only
         records the new demand."""
         res = self.get_or_create_resource(resource_id)
+        lease = None
         if (
             self.mode == "batch"
             and not res.in_learning_mode
@@ -919,8 +986,6 @@ class CapacityServer(CapacityServicer):
                     res._refresh_interval, request.wants,
                     request.subclients, request.priority,
                 )
-                if lease is not None:
-                    return lease, res
             elif res.store.has_client(request.client):
                 lease = res.store.assign(
                     request.client,
@@ -931,8 +996,13 @@ class CapacityServer(CapacityServicer):
                     request.subclients,
                     priority=request.priority,
                 )
-                return lease, res
-        return res.decide(request), res
+        if lease is None:
+            lease = res.decide(request)
+        if self._persist is not None:
+            # Every served lease is a journal delta; replay over the
+            # last snapshot reconstructs this exact store row.
+            self._persist.record_assign(resource_id, request.client, lease)
+        return lease, res
 
     # ------------------------------------------------------------------
     # Intermediate-server updater (refresh capacity from parent)
@@ -1081,6 +1151,12 @@ class CapacityServer(CapacityServicer):
                 for k, v in self._phase_totals().items()
             },
             "last_tick_ms": round(self._last_tick_seconds() * 1000.0, 3),
+            "persist": (
+                self._persist.status()
+                if self._persist is not None
+                else None
+            ),
+            "last_restore": self.last_restore,
             "resources": {
                 rid: res.status() for rid, res in self.resources.items()
             },

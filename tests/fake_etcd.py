@@ -107,21 +107,53 @@ class FakeEtcd:
         the response itself (/v3/watch)."""
         if path == "/v3/kv/range":
             key = _b64d(body["key"])
+            range_end = (
+                _b64d(body["range_end"]) if body.get("range_end") else None
+            )
             with self._lock:
                 self._sweep()
-                entry = self._kv.get(key)
-            if not entry:
+                if range_end is None:
+                    hits = (
+                        [(key, self._kv[key])] if key in self._kv else []
+                    )
+                else:
+                    hits = sorted(
+                        (k, v)
+                        for k, v in self._kv.items()
+                        if key <= k < range_end
+                    )
+            if not hits:
                 return {"count": "0"}
             return {
-                "count": "1",
+                "count": str(len(hits)),
                 "kvs": [
                     {
-                        "key": _b64e(key),
+                        "key": _b64e(k),
                         "value": _b64e(entry[0]),
                         "create_revision": str(entry[2]),
                     }
+                    for k, entry in hits
                 ],
             }
+        if path == "/v3/kv/deleterange":
+            key = _b64d(body["key"])
+            range_end = (
+                _b64d(body["range_end"]) if body.get("range_end") else None
+            )
+            with self._lock:
+                self._sweep()
+                if range_end is None:
+                    gone = [key] if key in self._kv else []
+                else:
+                    gone = [
+                        k for k in self._kv if key <= k < range_end
+                    ]
+                for k in gone:
+                    del self._kv[k]
+                if gone:
+                    self._revision += 1
+                    self._changed.notify_all()
+            return {"deleted": str(len(gone))}
         if path == "/v3/kv/put":
             key = _b64d(body["key"])
             value = _b64d(body["value"])

@@ -56,6 +56,32 @@ def test_master_flap_fails_over_without_split_brain(verdicts):
     assert ["s1"] in [m for _, m in timeline]
 
 
+def test_master_flap_warm_restores_instead_of_relearning(verdicts):
+    v = verdicts["master_flap_warm"]
+    plan = get_plan("master_flap_warm")
+    restores = [e for e in v["event_log"] if e[1] == "restore"]
+    # The initial election finds an empty backend (cold), the takeover
+    # finds the predecessor's state (warm).
+    assert [e[3] for e in restores] == ["cold_empty", "warm"]
+    warm = restores[-1]
+    server, mode, leases, clean_down, learning = warm[2:]
+    assert server == "s1" and leases == len(plan.setup["wants"])
+    # s0 stepped down cleanly, so the journal is complete and learning
+    # is skipped outright for the restored resource...
+    assert clean_down is True
+    assert learning == [["r0", "skip"]]
+    # ...which is what makes the 2-tick reconvergence budget meetable:
+    # the cold path would spend learning_mode_duration (10 ticks)
+    # serving conservative grants first.
+    assert plan.reconverge_ticks < plan.setup["learning_mode_duration"]
+    assert (
+        v["converged_after_heal_ticks"] <= plan.reconverge_ticks
+    )
+    # The takeover happened during the fault window, not after heal:
+    # restore, not relearn, is what closed the gap.
+    assert warm[0] < v["heal_tick"]
+
+
 def test_etcd_brownout_survives_single_hiccup_then_relearns(verdicts):
     v = verdicts["etcd_brownout"]
     plan = get_plan("etcd_brownout")

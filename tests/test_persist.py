@@ -1,0 +1,546 @@
+"""Persistence subsystem: snapshot/journal round-trip, crash replay,
+expiry-aware compaction, warm master takeover.
+
+The acceptance contract: serialize -> restore reproduces the LeaseStore
+state byte-identically (Python and native engines), a torn journal tail
+(crash mid-flush) loses at most the final flush batch, compaction drops
+only dead weight, and a fresh master restores + skips learning for
+fresh state while any corruption degrades to the cold path."""
+
+import asyncio
+import json
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.core.lease import Lease
+from doorman_tpu.core.store import LeaseStore
+from doorman_tpu.persist import PersistManager
+from doorman_tpu.persist import journal as journal_mod
+from doorman_tpu.persist import snapshot as snapshot_mod
+from doorman_tpu.persist.backend import (
+    FileBackend,
+    MemoryBackend,
+    parse_backend,
+)
+from doorman_tpu.persist.restore import learning_end_for, restore_server
+from doorman_tpu.persist.snapshot import SnapshotError
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+CONFIG = """
+resources:
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 30,
+              refresh_interval: 1, learning_mode_duration: 10}
+"""
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Backends
+# ---------------------------------------------------------------------------
+
+
+def test_file_backend_snapshot_atomic_and_journal(tmp_path):
+    b = FileBackend(str(tmp_path / "persist"))
+    assert b.read_snapshot() is None
+    b.write_snapshot(b"snap-1")
+    b.write_snapshot(b"snap-2")
+    assert b.read_snapshot() == b"snap-2"
+
+    assert b.read_journal() == []
+    b.append_journal([b"one", b"two"])
+    b.append_journal([b"three"])
+    assert b.read_journal() == [b"one", b"two", b"three"]
+    b.reset_journal([b"four"])
+    assert b.read_journal() == [b"four"]
+    b.reset_journal()
+    assert b.read_journal() == []
+
+
+def test_file_backend_surfaces_torn_tail(tmp_path):
+    b = FileBackend(str(tmp_path))
+    b.append_journal([b'[1,0,"d"]', b'[2,0,"d"]'])
+    with open(b._journal_path, "ab") as f:
+        f.write(b'[3,0,"d')  # crash mid-append: no newline, torn JSON
+    lines = b.read_journal()
+    assert lines[-1] == b'[3,0,"d'  # surfaced raw ...
+    recs = journal_mod.read_records(lines)
+    assert [r.seq for r in recs] == [1, 2]  # ... and dropped by the parser
+
+
+def test_parse_backend_specs(tmp_path):
+    assert isinstance(
+        parse_backend(f"file:{tmp_path}/p"), FileBackend
+    )
+    with pytest.raises(ValueError):
+        parse_backend("file")
+    with pytest.raises(ValueError):
+        parse_backend("s3:bucket")
+    with pytest.raises(ValueError):
+        parse_backend("etcd:/doorman/persist")  # no endpoints
+
+
+# ---------------------------------------------------------------------------
+# Snapshot framing
+# ---------------------------------------------------------------------------
+
+
+def _sample_snapshot():
+    return snapshot_mod.MasterSnapshot(
+        server_id="s0",
+        taken_at=123.5,
+        became_master_at=100.0,
+        config_epoch=7,
+        seq=42,
+        resources=[
+            snapshot_mod.ResourceSnapshot(
+                id="r0",
+                learning_mode_end=110.0,
+                rows=[("c0", 150.0, 1.0, 10.0, 20.0, 1, 0),
+                      ("c1", 151.0, 1.0, 30.0, 30.0, 2, 1)],
+            )
+        ],
+        server_bands=[("r0", "child", [0, 1])],
+    )
+
+
+def test_snapshot_round_trip():
+    snap = _sample_snapshot()
+    data = snapshot_mod.encode(snap)
+    again = snapshot_mod.decode(data)
+    assert again == snap
+    # Canonical: encoding the decoded snapshot is a fixpoint.
+    assert snapshot_mod.encode(again) == data
+
+
+def test_snapshot_rejects_corruption():
+    data = snapshot_mod.encode(_sample_snapshot())
+    flipped = data[:-5] + bytes([data[-5] ^ 0x01]) + data[-4:]
+    with pytest.raises(SnapshotError):
+        snapshot_mod.decode(flipped)
+    with pytest.raises(SnapshotError):
+        snapshot_mod.decode(data[: len(data) // 2])  # truncated payload
+    header, _, body = data.partition(b"\n")
+    env = json.loads(header)
+    env["format"] = 99
+    with pytest.raises(SnapshotError):
+        snapshot_mod.decode(
+            json.dumps(env).encode() + b"\n" + body
+        )
+
+
+# ---------------------------------------------------------------------------
+# Journal: replay after a mid-interval crash, compaction
+# ---------------------------------------------------------------------------
+
+
+def _lease(expiry, has=5.0, wants=10.0):
+    return Lease(expiry=expiry, refresh_interval=1.0, has=has,
+                 wants=wants, subclients=1, priority=0)
+
+
+def test_journal_replay_after_mid_interval_crash(tmp_path):
+    b = FileBackend(str(tmp_path))
+    j = journal_mod.Journal(b)
+    j.record_assign(1.0, "r0", "c0", _lease(100.0))
+    j.record_assign(2.0, "r0", "c1", _lease(101.0))
+    j.flush()
+    j.record_assign(3.0, "r0", "c2", _lease(102.0))
+    # CRASH: the third record was never flushed. A new writer process
+    # reads back only the flushed prefix.
+    recs = journal_mod.read_records(b.read_journal())
+    assert [(r.resource, r.client) for r in recs] == [
+        ("r0", "c0"), ("r0", "c1")
+    ]
+    # Replayed leases carry their exact values.
+    assert recs[0].lease == _lease(100.0)
+
+
+def test_journal_sequence_regression_fences_stale_suffix():
+    b = MemoryBackend()
+    b.append_journal([b'[5,1.0,"d"]', b'[3,2.0,"d"]', b'[6,3.0,"d"]'])
+    recs = journal_mod.read_records(b.read_journal())
+    # Stop at the first regression — everything after is suspect.
+    assert [r.seq for r in recs] == [5]
+
+
+def test_journal_compaction_is_expiry_aware():
+    b = MemoryBackend()
+    j = journal_mod.Journal(b)
+    j.record_assign(1.0, "r0", "dead", _lease(50.0))      # expires
+    j.record_assign(2.0, "r0", "live", _lease(500.0, has=1.0))
+    j.record_assign(3.0, "r0", "live", _lease(500.0, has=2.0))  # superseded
+    j.record_assign(4.0, "r0", "gone", _lease(500.0))
+    j.record_release(5.0, "r0", "gone")
+    j.record_down(6.0)
+    j.flush()
+    before, after = j.compact(now=100.0)
+    assert before == 6
+    recs = journal_mod.read_records(b.read_journal())
+    kinds = [(r.kind, r.client) for r in recs]
+    # Kept: the live client's LAST assign, the release (the snapshot
+    # underneath might still carry "gone"), the step-down marker.
+    assert kinds == [
+        ("a", "live"), ("r", "gone"), ("d", "")
+    ]
+    assert recs[0].lease.has == 2.0
+    assert after == 3
+    # Seqs survive compaction untouched (snapshot fencing still works).
+    assert [r.seq for r in recs] == [3, 5, 6]
+
+
+# ---------------------------------------------------------------------------
+# Warm takeover end to end (server-level)
+# ---------------------------------------------------------------------------
+
+
+def _mk_server(backend, clock, *, server_id="s0", native=False,
+               snapshot_interval=5.0):
+    persist = PersistManager(
+        backend, snapshot_interval=snapshot_interval,
+        flush_interval=1.0, clock=clock,
+    )
+    return CapacityServer(
+        server_id, TrivialElection(), mode="immediate",
+        clock=clock, native_store=native, persist=persist,
+    )
+
+
+def _decide(server, resource, client, wants, has=0.0):
+    from doorman_tpu.algorithms import Request
+
+    lease, _ = server._decide(resource, Request(client, has, wants, 1))
+    return lease
+
+
+async def _configured(server):
+    await server.load_config(parse_yaml_config(CONFIG))
+    return server
+
+
+def _store_rows(server, rid):
+    return sorted(server.resources[rid].store.dump_rows())
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_snapshot_restore_round_trip_byte_identical(native):
+    if native:
+        from doorman_tpu import native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("native store engine unavailable")
+
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock, native=native))
+        # Out of learning mode: decide real grants.
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        for i in range(5):
+            _decide(s0, "r0", f"c{i}", wants=10.0 * (i + 1))
+        clock.advance(1.0)
+        s0.persist_step()  # flush + first snapshot
+        want = _store_rows(s0, "r0")
+        assert len(want) == 5
+
+        clock.advance(1.0)
+        s1 = await _configured(
+            _mk_server(backend, clock, server_id="s1", native=native)
+        )
+        assert s1.last_restore is not None
+        assert s1.last_restore["mode"] == "warm"
+        assert s1.last_restore["leases_restored"] == 5
+        # Byte-identical store state: every lease row round-trips,
+        # including absolute expiry stamps.
+        assert _store_rows(s1, "r0") == want
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_journal_covers_post_snapshot_deltas_and_releases():
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        _decide(s0, "r0", "c0", wants=10.0)
+        _decide(s0, "r0", "c1", wants=20.0)
+        s0.persist_step()  # snapshot covers c0, c1
+        # Post-snapshot deltas ride the journal only:
+        _decide(s0, "r0", "c2", wants=30.0)
+        _decide(s0, "r0", "c0", wants=15.0)  # demand change
+        s0.resources["r0"].release("c1")
+        s0._persist.record_release("r0", "c1")
+        s0._persist.journal.flush()  # crash before the next snapshot
+        want = _store_rows(s0, "r0")
+
+        clock.advance(1.0)
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        assert s1.last_restore["mode"] == "warm"
+        assert _store_rows(s1, "r0") == want
+        assert not s1.resources["r0"].store.has_client("c1")
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_restore_drops_expired_and_clamps_overcommit():
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        res = s0.resources  # noqa: F841
+        r = s0.get_or_create_resource("r0")
+        # Hand-build grants that will overcommit a capacity cut and one
+        # lease that expires before the takeover.
+        r.store.assign("big", 30.0, 1.0, 80.0, 80.0, 1)
+        r.store.assign("small", 30.0, 1.0, 40.0, 40.0, 1)
+        r.store.assign("lapsing", 2.0, 1.0, 10.0, 10.0, 1)
+        for c in ("big", "small", "lapsing"):
+            s0._persist.record_assign("r0", c, r.store.get(c))
+        s0.persist_step()
+
+        clock.advance(5.0)  # "lapsing" is now expired
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        info = s1.last_restore["resources"]["r0"]
+        assert s1.last_restore["leases_dropped_expired"] == 1
+        assert info["clamped"] is True
+        store = s1.resources["r0"].store
+        assert not store.has_client("lapsing")
+        # Restored grants never exceed capacity (120 -> scaled to 100).
+        assert store.sum_has == pytest.approx(100.0)
+        assert store.get("big").has == pytest.approx(80.0 * 100.0 / 120.0)
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_corrupt_snapshot_falls_back_to_cold():
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        _decide(s0, "r0", "c0", wants=10.0)
+        s0.persist_step()
+        backend._snapshot = b"garbage" + backend._snapshot[10:]
+
+        clock.advance(1.0)
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        assert s1.last_restore["mode"] == "cold_error"
+        assert s1.resources == {}  # exactly the reference's cold wipe
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_learning_mode_semantics():
+    # Clean step-down: journal complete -> skip outright.
+    end, kind = learning_end_for(
+        age=500.0, clean_down=True, duration=10.0, became_master_at=1000.0
+    )
+    assert (end, kind) == (0.0, "skip")
+    # Crash with fresh state -> shorten to exactly the staleness.
+    end, kind = learning_end_for(
+        age=3.0, clean_down=False, duration=10.0, became_master_at=1000.0
+    )
+    assert (end, kind) == (1003.0, "shorten")
+    # Stale beyond the window -> the cold path.
+    end, kind = learning_end_for(
+        age=30.0, clean_down=False, duration=10.0, became_master_at=1000.0
+    )
+    assert (end, kind) == (1010.0, "cold")  # the full window, no more
+    # No learning window configured: nothing to skip.
+    assert learning_end_for(
+        age=0.0, clean_down=False, duration=0.0, became_master_at=1000.0
+    ) == (0.0, "skip")
+
+
+def test_warm_takeover_skips_learning_after_clean_step_down():
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        _decide(s0, "r0", "c0", wants=10.0)
+        s0.persist_step()
+        # Clean step-down writes the terminal marker.
+        await s0._on_is_master(False)
+
+        clock.advance(3.0)
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        assert s1.last_restore["clean_down"] is True
+        info = s1.last_restore["resources"]["r0"]
+        assert info["learning"] == "skip"
+        assert not s1.resources["r0"].in_learning_mode
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_crash_takeover_shortens_learning():
+    async def run():
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        _decide(s0, "r0", "c0", wants=10.0)
+        s0.persist_step()
+        # NO step-down marker: s0 just dies.
+
+        clock.advance(4.0)
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        info = s1.last_restore["resources"]["r0"]
+        assert info["learning"] == "shorten"
+        res = s1.resources["r0"]
+        assert res.in_learning_mode
+        # Learning covers exactly the 4s staleness, not the full 10s.
+        assert res.learning_mode_end == pytest.approx(clock.t + 4.0)
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_server_bands_rebuilt_from_restore():
+    async def run():
+        from doorman_tpu.server.server import _band_key
+
+        clock = FakeClock()
+        backend = MemoryBackend()
+        s0 = await _configured(_mk_server(backend, clock))
+        s0.resources = {}
+        s0.became_master_at = clock.t - 1000.0
+        r = s0.get_or_create_resource("r0")
+        bkey = _band_key("downstream", 1)
+        r.store.assign(bkey, 30.0, 1.0, 5.0, 5.0, 3, priority=1)
+        s0._persist.record_assign("r0", bkey, r.store.get(bkey))
+        s0._server_bands[("r0", "downstream")] = {1}
+        s0.persist_step()
+
+        clock.advance(1.0)
+        s1 = await _configured(_mk_server(backend, clock, server_id="s1"))
+        assert s1._server_bands == {("r0", "downstream"): {1}}
+        await s0.stop()
+        await s1.stop()
+
+    asyncio.run(run())
+
+
+def test_persist_obs_spans_and_metrics():
+    """Snapshot/restore land `persist.*` spans on the tracer and move
+    the default-registry gauges/histograms."""
+    from doorman_tpu.obs import metrics as metrics_mod
+    from doorman_tpu.obs import trace as trace_mod
+
+    async def run():
+        tracer = trace_mod.default_tracer()
+        tracer.enable(capacity=4096)
+        tracer.clear()
+        try:
+            clock = FakeClock()
+            backend = MemoryBackend()
+            s0 = await _configured(_mk_server(backend, clock))
+            s0.resources = {}
+            s0.became_master_at = clock.t - 1000.0
+            _decide(s0, "r0", "c0", wants=10.0)
+            s0.persist_step()
+            clock.advance(1.0)
+            s1 = await _configured(
+                _mk_server(backend, clock, server_id="s1")
+            )
+            names = {s.name for s in tracer.snapshot()}
+            assert "persist.snapshot" in names
+            assert "persist.restore" in names
+            assert tracer.open_spans() == []
+
+            reg = metrics_mod.default_registry()
+            assert reg.gauge(
+                "doorman_persist_snapshot_bytes", labels=("server",)
+            ).value("s0") > 0
+            assert reg.histogram(
+                "doorman_persist_restore_seconds"
+            ).count() >= 1
+            assert reg.counter(
+                "doorman_persist_restores_total",
+                labels=("server", "mode"),
+            ).value("s1", "warm") >= 1
+            await s0.stop()
+            await s1.stop()
+        finally:
+            tracer.disable()
+            tracer.clear()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Etcd backend over the real HTTP dialect (fake etcd)
+# ---------------------------------------------------------------------------
+
+
+def test_etcd_backend_chunked_round_trip():
+    from doorman_tpu.persist.backend import EtcdBackend
+    from doorman_tpu.server.etcd import EtcdGateway
+    from tests.fake_etcd import FakeEtcd
+
+    fake = FakeEtcd()
+    fake.start()
+    try:
+        gw = EtcdGateway([fake.address])
+        b = EtcdBackend(gw, "/doorman/persist", chunk_bytes=8)
+        assert b.read_snapshot() is None
+        data = b"0123456789abcdefXYZ"  # 3 chunks at 8 bytes
+        b.write_snapshot(data)
+        assert b.read_snapshot() == data
+        b.write_snapshot(b"gen2")  # supersede + prune gen 1
+        assert b.read_snapshot() == b"gen2"
+        assert gw.get_prefix("/doorman/persist/snap/00000001/") == []
+
+        b.append_journal([b"r1", b"r2"])
+        b.append_journal([b"r3"])
+        assert b.read_journal() == [b"r1", b"r2", b"r3"]
+        # A fresh backend instance recovers the append cursor.
+        b2 = EtcdBackend(gw, "/doorman/persist", chunk_bytes=8)
+        b2.append_journal([b"r4"])
+        assert b2.read_journal() == [b"r1", b"r2", b"r3", b"r4"]
+        b2.reset_journal([b"fresh"])
+        assert b2.read_journal() == [b"fresh"]
+    finally:
+        fake.stop()
+
+
+def test_etcd_gateway_prefix_helpers():
+    from doorman_tpu.server.etcd import prefix_range_end
+
+    assert prefix_range_end("/a/b/") == b"/a/b0"
+    assert prefix_range_end(b"\xff") == b"\x00"
+    assert prefix_range_end(b"a\xff") == b"b"

@@ -12,6 +12,11 @@ Invariants, for every algorithm lane and random demand table:
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
+
+# Optional dep: a build without hypothesis skips the property suite
+# instead of erroring the whole collection.
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import tests.conftest  # noqa: F401

@@ -1,0 +1,163 @@
+"""E2E drive: warm master takeover through the persistence subsystem.
+
+Server A runs the real CLI with `--persist file:<dir>`; a real client
+obtains a 40.0 grant; A is SIGKILLed mid-flight (a crash — no clean
+step-down marker) while the client still holds its lease; server B boots
+on the same state directory and port. Asserts the persistence contract
+from doc/persistence.md:
+
+  * B's takeover restore is `warm` and restores exactly the client's
+    lease with its granted value (never above capacity);
+  * learning mode is SHORTENED to the state's staleness (crash path),
+    not the full window;
+  * the still-connected client re-attains its full grant well inside
+    the learning window a cold takeover would have burned.
+
+Backs: operations.md "Failover runbook: warm master takeover".
+"""
+
+import asyncio
+import json
+import signal
+import sys
+import time
+import urllib.request
+
+from _common import (
+    ensure_ports_free,
+    platform_args,
+    spawn,
+    stop,
+    tail,
+    write_config,
+)
+
+PORT, DEBUG = 15341, 15342
+LEARNING_S = 5.0
+
+cfg = write_config(f"""
+resources:
+  - identifier_glob: "*"
+    capacity: 100
+    algorithm:
+      kind: PROPORTIONAL_SHARE
+      lease_length: 20
+      refresh_interval: 1
+      learning_mode_duration: {int(LEARNING_S)}
+""")
+
+import tempfile
+
+state_dir = tempfile.mkdtemp(suffix=".warm_takeover")
+
+
+def start_server(name):
+    return spawn(
+        [sys.executable, "-m", "doorman_tpu.cmd.server",
+         "--port", str(PORT), "--debug-port", str(DEBUG),
+         "--host", "127.0.0.1",
+         "--config", f"file:{cfg}",
+         "--mode", "immediate",
+         "--persist", f"file:{state_dir}",
+         "--snapshot-interval", "2"] + platform_args(),
+        name=name,
+    )
+
+
+def server_status(timeout=30):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{DEBUG}/debug/vars", timeout=2
+            ) as r:
+                return json.loads(r.read())["servers"][0]
+        except Exception as e:
+            last = e
+            time.sleep(0.3)
+    raise SystemExit(f"debug port never answered: {last!r}")
+
+
+async def wait_capacity(res, want, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if res.current_capacity() == want:
+            return time.time()
+        await asyncio.sleep(0.25)
+    raise SystemExit(
+        f"capacity never reached {want}: {res.current_capacity()}"
+    )
+
+
+async def main():
+    from doorman_tpu.client.client import Client
+
+    ensure_ports_free(PORT, DEBUG)
+    a = start_server("warm-a")
+    client = None
+    b = None
+    try:
+        st = server_status()
+        assert (st["last_restore"] or {}).get("mode") == "cold_empty", st
+
+        client = await Client.connect(
+            f"127.0.0.1:{PORT}", "warm-drive-client",
+            minimum_refresh_interval=0.0,
+        )
+        res = await client.resource("r0", 40.0)
+        await wait_capacity(res, 40.0)
+        await asyncio.sleep(3.0)  # a snapshot lands past the grant
+        st = server_status()
+        assert st["persist"]["last_snapshot_age"] is not None, st
+
+        a.send_signal(signal.SIGKILL)  # crash: no step-down marker
+        a.wait()
+        # The kill IS the scenario — drop A's log like a clean stop
+        # would (stop() would read the -9 as a pre-existing failure).
+        import os
+
+        os.unlink(a._drive_log)
+
+        b = start_server("warm-b")
+        t_up = time.time()
+        st = server_status()
+        lr = st["last_restore"]
+        assert lr and lr["mode"] == "warm", lr
+        assert lr["leases_restored"] == 1, lr
+        r0 = lr["resources"]["r0"]
+        assert r0["learning"] == "shorten", r0
+        assert r0["sum_has"] == 40.0, r0
+        assert r0["sum_has"] <= r0["capacity"], r0
+
+        t_ok = await wait_capacity(res, 40.0, timeout=LEARNING_S + 25.0)
+        regain_s = t_ok - t_up
+        # The restored grant must be served without waiting out the
+        # learning window (shortened to ~the crash staleness). Allow
+        # generous process-spawn slack; the cold path would add the
+        # FULL window on top of it.
+        assert regain_s < LEARNING_S + 15.0, regain_s
+        print(
+            f"warm takeover OK: restored 1 lease (sum_has=40/100), "
+            f"learning shortened, client re-attained its grant "
+            f"{regain_s:.1f}s after the successor booted "
+            f"(learning window: {LEARNING_S:.0f}s)"
+        )
+        print("DRIVE warm_takeover OK")
+    except BaseException:
+        for proc in (a, b):
+            if proc is not None:
+                print(tail(proc))
+        raise
+    finally:
+        if client is not None:
+            try:
+                await asyncio.wait_for(client.close(), 10)
+            except Exception:
+                pass
+        for proc in (a, b):
+            if proc is not None:
+                stop(proc)
+
+
+asyncio.run(main())

@@ -20,6 +20,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 QUICK = [
     ("drive_election_blackhole.py", 420),
     ("drive_flip.py", 420),
+    ("drive_warm_takeover.py", 420),
     ("drive_priority.py", 420),
     ("drive_tree.py", 480),
     ("drive_tree3.py", 480),
