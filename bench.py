@@ -692,6 +692,174 @@ def bench_server_tick_wide() -> None:
         )
 
 
+def bench_server_tick_wide_mesh() -> None:
+    """Fourth metric: the WIDE server tick with the device table
+    MESH-SHARDED across every visible chip (solver/resident_wide.py
+    with mesh=) at the headline shape, 1 resource x 1M clients. Same
+    workload, pipelining and warmup discipline as
+    bench_server_tick_wide's 1res_1m case, so the reported scaling is
+    the mesh's doing alone; `scaling_vs_1device` divides the 1-device
+    median (measured earlier in this run) by this one.
+
+    Requires >= 2 devices (and >= the --mesh-devices request): with
+    fewer this emits a `diagnostic` entry — NOT a metric row — per the
+    BENCH_r05 backend_unreachable convention, so trajectory tooling
+    never ingests a single-device number as a mesh measurement.
+    """
+    import jax
+
+    from doorman_tpu import native
+    from doorman_tpu.core.resource import Resource
+    from doorman_tpu.parallel import make_mesh
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.solver.resident_wide import WideResidentSolver
+
+    devices = jax.devices()
+    requested = max(MESH_BENCH_DEVICES or len(devices), 2)
+    if len(devices) < requested:
+        diagnostic(
+            {
+                "diagnostic": "mesh_devices_unavailable",
+                "available": len(devices),
+                "requested": requested,
+                "note": (
+                    "server_tick_wide_mesh needs >=2 devices; set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                    "for a CPU dry-run"
+                ),
+            }
+        )
+        return
+    devices = devices[:requested]
+    n_dev = len(devices)
+    mesh = make_mesh([n_dev], ("clients",), devices)
+    if devices[0].platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
+
+    R, C = 1, 1_000_000
+    rng = np.random.default_rng(23)
+    engine = native.StoreEngine()
+    capacity = float(C) * 40.0
+    tpl = pb.ResourceTemplate(
+        identifier_glob="wide0",
+        capacity=capacity,
+        algorithm=pb.Algorithm(
+            kind=pb.Algorithm.PROPORTIONAL_SHARE,
+            lease_length=600, refresh_interval=16,
+        ),
+    )
+    res = Resource("wide0", tpl, store_factory=engine.store)
+    rids = np.full(R * C, res.store._rid, np.int32)
+    cids = np.array(
+        [engine.client_handle(f"w{i}") for i in range(R * C)], np.int64
+    )
+    wants = rng.integers(1, 100, R * C).astype(np.float64)
+    now = time.time()
+    engine.bulk_assign(
+        rids, cids, np.full(R * C, now + 600.0),
+        np.full(R * C, 16.0), np.zeros(R * C), wants,
+        np.ones(R * C, np.int32),
+    )
+    resources = [res]
+
+    solver = WideResidentSolver(
+        engine, dtype=dtype, mesh=mesh, rotate_ticks=1
+    )
+    solver.step(resources)  # build + compile + full delivery
+
+    # Oracle spot-check of the first (deliver-everything) tick.
+    from doorman_tpu.algorithms.tick import oracle_row
+
+    expected = oracle_row(
+        int(pb.Algorithm.PROPORTIONAL_SHARE), capacity, 0.0,
+        wants.astype(np.float64), np.zeros(R * C), np.ones(R * C),
+    )
+    sample = rng.integers(0, C, 20)
+    got = np.array([res.store.get(f"w{i}").has for i in sample])
+    np.testing.assert_allclose(
+        got, expected[sample], rtol=2e-6, atol=1e-4,
+        err_msg="mesh wide first tick",
+    )
+
+    solver.rotate_ticks = SERVER_ROTATE_TICKS
+    n_churn = (R * C) // 20
+    n_ticks = SERVER_WARMUP + TICKS_WIDE
+    churn_edges = [
+        rng.choice(R * C, n_churn, replace=False) for _ in range(n_ticks)
+    ]
+    churn_wants = [
+        rng.integers(1, 100, n_churn).astype(np.float64)
+        for _ in range(n_ticks)
+    ]
+
+    tick_ms = []
+    handles = []
+    phase_mark = {}
+    collects_mark = 0
+    phase_samples = [dict(solver.phase_s)]
+    for t in range(n_ticks):
+        if t == SERVER_WARMUP:
+            phase_mark = dict(solver.phase_s)
+            collects_mark = solver.ticks
+        t0 = time.perf_counter()
+        edge = churn_edges[t]
+        engine.bulk_refresh(
+            rids[edge], cids[edge],
+            np.full(n_churn, time.time() + 600.0),
+            np.full(n_churn, 16.0), churn_wants[t],
+        )
+        handles.append(solver.dispatch(resources))
+        if len(handles) >= PIPELINE_DEPTH_SERVER:
+            solver.collect(handles.pop(0))
+        tick_ms.append((time.perf_counter() - t0) * 1000.0)
+        phase_samples.append(dict(solver.phase_s))
+    t0 = time.perf_counter()
+    for h in handles:
+        solver.collect(h)
+    drain_ms = (time.perf_counter() - t0) * 1000.0
+    timed = sorted(t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:])
+    med = float(np.median(timed))
+    phases = phase_attribution(solver, phase_mark, collects_mark, TICKS_WIDE)
+    # The 1-device comparator measured earlier in this same run (same
+    # shape, same workload); absent when that bench did not run.
+    base = next(
+        (
+            r["value"]
+            for r in _EMITTED
+            if r.get("metric") == "server_tick_wide_1res_1m_wall_ms"
+        ),
+        None,
+    )
+    emit(
+        {
+            "metric": "server_tick_wide_mesh_1res_1m_wall_ms",
+            "value": round(med, 3),
+            "unit": "ms",
+            "vs_baseline": round(SERVER_TICK_TARGET_MS / med, 3),
+            "selection": f"median_of_{TICKS_WIDE}",
+            "best_ms": round(timed[0], 3),
+            "p50_ms": round(float(np.percentile(timed, 50)), 3),
+            "p90_ms": round(float(np.percentile(timed, 90)), 3),
+            "p99_ms": round(float(np.percentile(timed, 99)), 3),
+            "devices": n_dev,
+            "chunk_rows": solver._R,
+            "rotate_ticks": SERVER_ROTATE_TICKS,
+            "scaling_vs_1device": (
+                round(base / med, 3) if base else None
+            ),
+            "phase_ms": phases,
+        },
+        artifact_extra={
+            "phase_ms_per_tick": phase_deltas_ms(phase_samples)[
+                SERVER_WARMUP:
+            ],
+        },
+    )
+
+
 def gate_pallas_kernels() -> None:
     """Real-TPU pallas regression gate: compile and run BOTH pallas
     kernels (dense lanes + banded priority water-fill) on the chip and
@@ -820,6 +988,9 @@ SERVER_WARMUP = 6
 # round-4 verdict asked for percentiles over a long window on record).
 TICKS_SERVER = 100
 TICKS_WIDE = 40
+# --mesh-devices: how many devices the mesh bench shards over (0 = all
+# visible). Fewer available than requested (or than 2) => diagnostic.
+MESH_BENCH_DEVICES = 0
 
 
 def _require_backend() -> None:
@@ -876,7 +1047,14 @@ if __name__ == "__main__":
         help="capture a device-side jax.profiler trace of the headline "
              "measured solve into this directory (xprof/tensorboard)",
     )
+    _ap.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="devices for the mesh-sharded wide bench (0 = all "
+             "visible; a diagnostic is emitted when fewer than "
+             "max(requested, 2) are available)",
+    )
     _args = _ap.parse_args()
+    MESH_BENCH_DEVICES = max(_args.mesh_devices, 0)
     if _args.trace:
         _trace_mod.default_tracer().enable()
     _require_backend()
@@ -886,6 +1064,9 @@ if __name__ == "__main__":
         with _trace_mod.jax_capture(_args.jax_trace or None):
             main()
         bench_server_tick_wide()
+        # After the 1-device wide bench, so scaling_vs_1device can read
+        # its median from this run's emitted results.
+        bench_server_tick_wide_mesh()
         # The narrow server tick stays LAST: the driver parses the final
         # JSON line as the round's headline metric.
         bench_server_tick()

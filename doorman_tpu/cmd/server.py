@@ -87,6 +87,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--snapshot-interval", type=float, default=30.0,
                    help="seconds between full state snapshots (journal "
                         "deltas cover the gaps)")
+    p.add_argument("--mesh", default="",
+                   help="batch mode: shard the device-resident tick "
+                        "across a device mesh — 'auto' (every visible "
+                        "device, one axis) or per-axis sizes like '8' "
+                        "or '2x4' (product must equal the device "
+                        "count). Initializes the JAX backend at "
+                        "startup; store contents stay bit-identical "
+                        "to the single-device tick (doc/parallel.md)")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -138,6 +146,23 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         log.info("persistence enabled: %s (snapshot every %.1fs)",
                  args.persist, args.snapshot_interval)
 
+    mesh = None
+    if args.mesh:
+        from doorman_tpu.parallel.mesh import make_mesh_from_spec
+
+        # Fail fast and loud: a server silently falling back to one
+        # device after the operator asked for a mesh would hide a 1/Nth
+        # capacity deployment error until the first overloaded tick.
+        try:
+            mesh = make_mesh_from_spec(args.mesh)
+        except ValueError as e:
+            log.error("--mesh %s unusable: %s", args.mesh, e)
+            raise SystemExit(2)
+        log.info(
+            "resident tick mesh: %s over %d devices",
+            dict(mesh.shape), mesh.devices.size,
+        )
+
     server_id = args.server_id or f"{args.host}:{args.port}"
     server = CapacityServer(
         server_id,
@@ -153,6 +178,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         profile_ticks=args.profile_ticks,
         solver_dtype=args.solver_dtype,
         persist=persist,
+        mesh=mesh,
     )
 
     port = await server.start(

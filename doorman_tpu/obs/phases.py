@@ -54,6 +54,28 @@ def _phase_metrics() -> Tuple[metrics_mod.Histogram, metrics_mod.Gauge]:
     return hist, last
 
 
+def _shard_metrics() -> Tuple[metrics_mod.Gauge, metrics_mod.Gauge]:
+    """Per-shard host-link traffic of one mesh-resident tick, for
+    spotting an unbalanced delivery. The bytes reported are the REAL
+    payloads (dirty slots, delivered rows); the wire additionally ships
+    each shard's block padded to the max shard's bucketed width, so the
+    skew ratio also reads as that padding's waste."""
+    reg = metrics_mod.default_registry()
+    per = reg.gauge(
+        "doorman_tick_shard_bytes",
+        "Per-shard host-link payload bytes of the last mesh-sharded "
+        "tick (direction: upload/download).",
+        labels=("component", "direction", "shard"),
+    )
+    skew = reg.gauge(
+        "doorman_tick_shard_skew",
+        "max/mean ratio of per-shard payload bytes for the last "
+        "mesh-sharded tick (1.0 = perfectly balanced).",
+        labels=("component", "direction"),
+    )
+    return per, skew
+
+
 class PhaseRecorder:
     """Times consecutive laps of one tick for one component.
 
@@ -86,6 +108,30 @@ class PhaseRecorder:
     def record(self, phase: str, seconds: float) -> None:
         """Record an interval that ended now (measured by the caller)."""
         self._record(phase, seconds, time.perf_counter())
+
+    def shard_bytes(self, direction: str, per_shard) -> None:
+        """Per-shard payload bytes of one mesh-sharded tick. Lands as
+        `doorman_tick_shard_bytes{component,direction,shard}` gauges
+        plus a skew gauge (max/mean), and — when the tracer is on — a
+        `shard.<direction>` instant on the timeline, so an unbalanced
+        delivery shows up in /debug/traces right next to the tick's
+        phase spans."""
+        per = [int(b) for b in per_shard]
+        if not per:
+            return
+        per_g, skew_g = _shard_metrics()
+        for d, b in enumerate(per):
+            per_g.set(b, self._component, direction, str(d))
+        mean = sum(per) / len(per)
+        skew = (max(per) / mean) if mean > 0 else 1.0
+        skew_g.set(skew, self._component, direction)
+        tracer = trace_mod.default_tracer()
+        if tracer.enabled:
+            tracer.instant(
+                f"shard.{direction}",
+                cat=f"phase:{self._component}",
+                args={"bytes": per, "skew": round(skew, 3)},
+            )
 
     def _record(self, phase: str, seconds: float, end: float) -> None:
         self._totals[phase] = self._totals.get(phase, 0.0) + seconds

@@ -31,3 +31,32 @@ def make_mesh(
         )
     dev_array = np.array(devices).reshape(axis_sizes)
     return Mesh(dev_array, axis_names)
+
+
+def make_mesh_from_spec(
+    spec: str, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh from an operator-facing axis-size spec.
+
+    '8' or '2x4' name per-axis sizes (their product must equal the
+    device count — make_mesh validates); '' or 'auto' takes one axis
+    over every visible device. Axis names follow the repo convention:
+    one axis -> ("clients",), two -> ("dc", "clients"), more -> ax<i>.
+    The row-sharded resident solvers flatten all axes anyway; the names
+    matter only for the edge-sharded solve's dc_aggregates view.
+    """
+    spec = (spec or "").strip().lower()
+    if spec in ("", "auto"):
+        devices = list(devices if devices is not None else jax.devices())
+        return make_mesh(devices=devices)
+    try:
+        sizes = [int(p) for p in spec.replace("*", "x").split("x")]
+    except ValueError:
+        raise ValueError(
+            f"bad mesh spec {spec!r}: want 'auto', '8', or '2x4'"
+        ) from None
+    names = {
+        1: ("clients",),
+        2: ("dc", "clients"),
+    }.get(len(sizes)) or tuple(f"ax{i}" for i in range(len(sizes)))
+    return make_mesh(sizes, names, devices)

@@ -200,6 +200,70 @@ def make_sharded_chunked_solver(mesh: Mesh, *, donate: bool = False):
     return solve
 
 
+def resident_chunk_reduces(
+    mesh: Mesh,
+    row_seg,
+    num_segments: int,
+    rows_per_shard: int,
+):
+    """Cross-shard chunk reduction for the MESH-RESIDENT wide tick
+    (solver.resident_wide with mesh=): the shard-local halves of
+    solve_chunked's two-level reduction combined over ICI, like
+    make_sharded_chunked_solver — but assembled so totals come out
+    BIT-IDENTICAL to the single-device solve.
+
+    make_sharded_chunked_solver psums per-shard [S] partial totals,
+    which re-associates the float sum of any resource whose chunks
+    straddle a shard boundary (fine for a stateless solve pinned by
+    allclose tests; not for the resident path, whose store-parity
+    invariant is byte equality with the single-device tick).  Here each
+    shard instead contributes its per-row reductions into the GLOBAL
+    [R] row vector at its own offset; the psum adds disjoint supports
+    (every other shard holds the identity — exact), so the assembled
+    vector is bitwise the single-device row-total vector, and every
+    shard then runs the SAME sorted segment op over it.  Straddling
+    chunks need no special case — their rows assemble from two shards.
+    Traffic: one [R]-sized psum/pmax per reduce call (the [S] variant's
+    collective is smaller, but R is only ~#clients/W).
+
+    Returns (segsum, segmax) taking the shard-local [Rl, W] lease block
+    and returning replicated [S] totals — plug into solve_lanes with
+    expand=totals[row_seg_local][:, None].
+    """
+    axes = tuple(mesh.axis_names)
+    shape = dict(mesh.shape)
+    row_seg = jnp.asarray(np.asarray(row_seg), jnp.int32)
+    R = int(row_seg.shape[0])
+
+    def shard_base():
+        # Linear shard index in mesh-axis order -> global row offset.
+        idx = jnp.zeros((), jnp.int32)
+        for ax in axes:
+            idx = idx * shape[ax] + jax.lax.axis_index(ax)
+        return idx * rows_per_shard
+
+    def assemble(local, fill, combine):
+        rows = jnp.full((R,), fill, local.dtype)
+        rows = jax.lax.dynamic_update_slice(rows, local, (shard_base(),))
+        return combine(rows, axes)
+
+    def segsum(v):
+        rows = assemble(v.sum(axis=1), 0, jax.lax.psum)
+        return jax.ops.segment_sum(
+            rows, row_seg, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    def segmax(v):
+        rows = assemble(v.max(axis=1), -jnp.inf, jax.lax.pmax)
+        return jax.ops.segment_max(
+            rows, row_seg, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    return segsum, segmax
+
+
 def shard_chunked(mesh: Mesh, batch):
     """Place a ChunkedDenseBatch on the mesh: chunk rows (and row_seg)
     sharded over all mesh axes, padded with inactive rows mapped to the

@@ -100,6 +100,7 @@ class CapacityServer(CapacityServicer):
         profile_ticks: int = 8,
         solver_dtype: str = "f64",
         persist=None,  # Optional[doorman_tpu.persist.PersistManager]
+        mesh=None,  # Optional[jax.sharding.Mesh] for the resident tick
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -165,6 +166,12 @@ class CapacityServer(CapacityServicer):
         self._resident_handle = None
         self._resident_ok_key = None
         self._resident_ok = False
+        # Optional device mesh for the resident solvers: table rows
+        # shard across its devices and each tick is a shard_mapped
+        # solve (store contents stay bit-identical to the single-device
+        # tick; see doc/parallel.md). The BatchSolver fallback paths
+        # (ResidentOverflow, priority part) stay single-device.
+        self._solver_mesh = mesh
         # Wide lane resources (wider than the dense bucket cap) tick
         # through their own chunked resident solver; the partition is
         # recomputed with the eligibility key.
@@ -444,6 +451,7 @@ class CapacityServer(CapacityServicer):
             engine = self._store_factory.__self__
             self._resident = ResidentDenseSolver(
                 engine, dtype=dtype, clock=self._clock,
+                mesh=self._solver_mesh,
                 # Grant delivery rides the config's fastest refresh
                 # cadence relative to this server's tick cadence.
                 rotate_ticks=None, tick_interval=self.tick_interval,
@@ -463,6 +471,7 @@ class CapacityServer(CapacityServicer):
             engine = self._store_factory.__self__
             self._resident_wide = WideResidentSolver(
                 engine, dtype=dtype, clock=self._clock,
+                mesh=self._solver_mesh,
                 rotate_ticks=None, tick_interval=self.tick_interval,
             )
         return self._resident_wide
@@ -1138,6 +1147,13 @@ class CapacityServer(CapacityServicer):
             # backend init from the status page, hanging the debug
             # thread when the device tunnel is down).
             "backend": self._backend_platform(),
+            # Axis sizes of the resident solvers' device mesh (None:
+            # single-device resident ticks).
+            "mesh": (
+                {str(k): int(v) for k, v in self._solver_mesh.shape.items()}
+                if self._solver_mesh is not None
+                else None
+            ),
             "ticks": self._ticks_done,
             # Ticks the resident solver served without device work (the
             # idle fast path); a busy server shows 0 here.

@@ -160,6 +160,9 @@ def solve_lanes(
 
     # A where-chain rather than jnp.select: identical semantics, and it
     # lowers on every backend pallas targets (select's argmax does not).
+    # int(): an IntEnum operand becomes a strong-typed int64 scalar
+    # const, which a pallas kernel body may not capture (it rejects any
+    # non-ref closure constant); a Python int stays a weak-typed literal.
     kind_e = expand(algo_kind)
     gets = jnp.zeros_like(wants)
     for kind_value, lane in (
@@ -169,7 +172,7 @@ def solve_lanes(
         (AlgoKind.FAIR_SHARE, gets_fair),
         (AlgoKind.PROPORTIONAL_TOPUP, gets_topup),
     ):
-        gets = jnp.where(kind_e == kind_value, lane, gets)
+        gets = jnp.where(kind_e == int(kind_value), lane, gets)
     # Learning-mode resources replay reported grants regardless of lane
     # (reference resource.go:108-111).
     gets = jnp.where(expand(learning), gets_learn, gets)

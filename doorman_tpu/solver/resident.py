@@ -76,6 +76,39 @@ class ResidentOverflow(RuntimeError):
     to the BatchSolver path (its edge layout has no width limit)."""
 
 
+def place(arr, *, device=None, sharding=None):
+    """The resident solvers' single placement chokepoint: every device
+    table, config column, and staged per-tick block lands through here,
+    so the single-device path (explicit device or backend default) and
+    the mesh path (a NamedSharding) cannot drift apart."""
+    import jax
+
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return jax.device_put(arr, device)
+
+
+def landed_rows(handle: "TickHandle") -> np.ndarray:
+    """Land a tick's download into [n_sel, W] float64 rows (shared by
+    the narrow and wide collect paths). Single-device ticks land as one
+    padded [Sb, W] slab; mesh ticks as [n_dev, Sb, W] per-shard blocks
+    whose real rows concatenate in shard-major order — exactly the
+    sorted order of handle.sel_rows."""
+    from doorman_tpu.utils.transfer import land_parts
+
+    gets = np.asarray(land_parts(handle.out), np.float64)
+    if handle.shard_counts is None:
+        return gets[: handle.n_sel]
+    parts = [
+        gets[d, : int(c)]
+        for d, c in enumerate(handle.shard_counts)
+        if int(c)
+    ]
+    if not parts:
+        return np.zeros((0, gets.shape[-1]))
+    return np.concatenate(parts)
+
+
 @dataclass
 class TickHandle:
     """One in-flight tick: the device output plus everything collect()
@@ -93,6 +126,11 @@ class TickHandle:
     # Wide (chunked) ticks only: the chunk number per selected row
     # (solver.resident_wide writes back via apply_chunks).
     chunks: "np.ndarray | None" = None
+    # Mesh ticks only: real delivered rows per shard. out lands as
+    # [n_dev, Sb, W] (one padded block per shard) and collect
+    # reassembles the first shard_counts[d] rows of each block — in
+    # shard-major order, which IS the sorted global order of sel_rows.
+    shard_counts: "np.ndarray | None" = None
 
 
 class ResidentDenseSolver:
@@ -109,6 +147,7 @@ class ResidentDenseSolver:
         *,
         dtype=np.float32,
         device=None,
+        mesh=None,
         clock: Callable[[], float] = time.time,
         rotate_ticks: "int | None" = 8,
         tick_interval: "float | None" = None,
@@ -123,6 +162,18 @@ class ResidentDenseSolver:
         self._engine = engine
         self._dtype = np.dtype(dtype)
         self._device = device
+        # A parallel.mesh Mesh shards the table rows (and the per-tick
+        # scatter/delivery traffic) across every mesh axis; rows are
+        # independent here (one row = one resource), so the sharded
+        # tick needs no collectives — pure scale-out. `device` is
+        # ignored under a mesh (placement follows the mesh's devices).
+        self._mesh = mesh
+        self._meshrows = None
+        if mesh is not None:
+            from doorman_tpu.solver.resident_mesh import MeshRows
+
+            self._meshrows = MeshRows(mesh)
+        self._rot_shard_cursors: "np.ndarray | None" = None
         self._clock = clock
         # rotate_ticks=None derives the rotation from the config each
         # time templates are read: delivery rides the fastest refresh
@@ -192,10 +243,17 @@ class ResidentDenseSolver:
         self._rotate_override = max(int(value), 1)
         self._rotate = self._rotate_override
 
-    def _put(self, arr):
-        import jax
+    def _put(self, arr, sharding=None):
+        return place(arr, device=self._device, sharding=sharding)
 
-        return jax.device_put(arr, self._device)
+    def _put_rows(self, arr):
+        """Row-axis placement: table rows / per-row config split over
+        the mesh (axis 0 is always a multiple of the device count),
+        per-shard staged blocks split by their leading device axis.
+        Without a mesh this is the plain single-device put."""
+        if self._meshrows is None:
+            return self._put(arr)
+        return self._put(arr, self._meshrows.shard0(np.ndim(arr)))
 
     def _read_config(self, rows: Sequence[Resource]) -> None:
         """One pass over the templates (10k protobuf reads cost ~30ms at
@@ -240,9 +298,9 @@ class ResidentDenseSolver:
                 ),
             )
         if self._kind_h is None or not np.array_equal(kind, self._kind_h):
-            self._kind_h, self._kind_d = kind, self._put(kind)
+            self._kind_h, self._kind_d = kind, self._put_rows(kind)
         if self._statc_h is None or not np.array_equal(statc, self._statc_h):
-            self._statc_h, self._statc_d = statc, self._put(statc)
+            self._statc_h, self._statc_d = statc, self._put_rows(statc)
 
     def _refresh_config(
         self, rows: Sequence[Resource], config_epoch: int, now: float
@@ -274,9 +332,9 @@ class ResidentDenseSolver:
             mask = (cap != self._cap_h) | (learn != self._learn_h)
             changed = np.nonzero(mask)[0]
         if self._cap_h is None or not np.array_equal(cap, self._cap_h):
-            self._cap_h, self._cap_d = cap, self._put(cap)
+            self._cap_h, self._cap_d = cap, self._put_rows(cap)
         if self._learn_h is None or not np.array_equal(learn, self._learn_h):
-            self._learn_h, self._learn_d = learn, self._put(learn)
+            self._learn_h, self._learn_d = learn, self._put_rows(learn)
         return changed
 
     # -- build / rebuild ----------------------------------------------
@@ -297,6 +355,13 @@ class ResidentDenseSolver:
         # +1 reserves a padding row: ticks with no dirty rows scatter a
         # zero row there instead of disturbing a live row's has chain.
         self._Rp = _round_rows(self._R + 1)
+        if self._meshrows is not None:
+            # Equal row blocks per shard; fresh per-shard rotation
+            # cursors (the old ones indexed the old partition).
+            self._Rp = self._meshrows.round_rows(self._Rp)
+            self._rot_shard_cursors = np.zeros(
+                self._meshrows.n_dev, np.int64
+            )
         self._rids = np.full(self._Rp, -1, np.int32)
         for i, r in enumerate(rows):
             self._rids[i] = r.store._rid
@@ -332,10 +397,10 @@ class ResidentDenseSolver:
         self._K = K
         self._kfill = min(K, _ceil_to(kmax, 8))
         dtype = self._dtype
-        self._wants = self._put(w.astype(dtype))
-        self._has = self._put(h.astype(dtype))
-        self._sub = self._put(s.astype(dtype))
-        self._act = self._put(act.astype(bool))
+        self._wants = self._put_rows(w.astype(dtype))
+        self._has = self._put_rows(h.astype(dtype))
+        self._sub = self._put_rows(s.astype(dtype))
+        self._act = self._put_rows(act.astype(bool))
         self._uploaded_versions = versions
         self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
         self._cap_raw = None
@@ -352,7 +417,112 @@ class ResidentDenseSolver:
             a is not b for a, b in zip(resources, self._rows)
         )
 
+    def _rotation_rows(self) -> np.ndarray:
+        """This tick's rotation slice (advances the cursor state).
+        Single device: one cursor walks all R rows. Mesh: per-shard
+        cursors walk each shard's own real rows, so every tick's
+        delivery download stays balanced across shards instead of one
+        contiguous window marching through them."""
+        if self._meshrows is None:
+            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
+            rot = (
+                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
+            ) % max(self._R, 1)
+            self._rot_cursor = (
+                self._rot_cursor + rot_block
+            ) % max(self._R, 1)
+            return rot
+        return self._meshrows.rotation_rows(
+            self._rot_shard_cursors, self._R,
+            self._Rp // self._meshrows.n_dev, self.rotate_ticks,
+        )
+
     # -- the tick executable ------------------------------------------
+
+    def _tick_fn_mesh(self, Da: int, Df: int, Sb: int):
+        """The shard_mapped tick: tables row-sharded over the mesh,
+        staged blocks pre-partitioned per shard (leading device axis),
+        no collectives (rows are independent). Scatter indices are
+        shard-LOCAL; padded scatter slots carry the out-of-range index
+        Rl and drop, padded gather slots repeat a valid index and are
+        sliced off at collect."""
+        key = (Da, Df, Sb, self._kfill)
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.solver.batch import _committed_platform
+        from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+        use_pallas = (
+            _committed_platform(self._wants) == "tpu"
+            and self._dtype == np.float32
+        )
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+            solve = solve_dense_pallas
+        else:
+            solve = solve_dense
+        kfill = self._kfill
+        out_dtype = self._out_dtype
+        axes = self._meshrows.axes
+
+        def body(wants, has, sub, act, idx, a_w, f_block, f_act,
+                 cap, kind, learn, statc):
+            # Per-shard staged blocks arrive as [1, ...]; tables and
+            # per-row config as this shard's [Rl, ...] block.
+            idx = idx[0]
+            a_idx = idx[:Da]
+            f_idx = idx[Da:Da + Df]
+            sel_idx = idx[Da + Df:]
+            wants = wants.at[a_idx, :kfill].set(a_w[0], mode="drop")
+            has = has.at[f_idx, :kfill].set(f_block[0, 0], mode="drop")
+            sub = sub.at[f_idx, :kfill].set(f_block[0, 1], mode="drop")
+            act = act.at[f_idx, :kfill].set(f_act[0], mode="drop")
+            gets = solve(
+                DenseBatch(
+                    wants=wants, has=has, subclients=sub, active=act,
+                    capacity=cap, algo_kind=kind, learning=learn,
+                    static_capacity=statc,
+                )
+            )
+            out = jnp.take(
+                gets, sel_idx, axis=0, mode="clip",
+                indices_are_sorted=True,
+            )[:, :kfill].astype(out_dtype)
+            return wants, gets, sub, act, out[None]
+
+        rowk = P(axes, None)
+        row = P(axes)
+        dev2 = P(axes, None, None)
+        mapped = shard_map(
+            body,
+            mesh=self._mesh,
+            in_specs=(
+                rowk, rowk, rowk, rowk,  # tables
+                rowk,  # fused idx [n_dev, Da+Df+Sb]
+                dev2,  # a_w [n_dev, Da, kfill]
+                P(axes, None, None, None),  # f_block [n_dev, 2, Df, kfill]
+                dev2,  # f_act [n_dev, Df, kfill]
+                row, row, row, row,  # per-row config
+            ),
+            out_specs=(rowk, rowk, rowk, rowk, dev2),
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(*args):
+            return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
 
     def _tick_fn(self, Da: int, Df: int, Sb: int):
         key = (Da, Df, Sb, self._kfill)
@@ -530,19 +700,18 @@ class ResidentDenseSolver:
             self._just_rebuilt = False
             sel = np.arange(max(self._R, 1), dtype=np.int64)
         else:
-            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
-            rot = (
-                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
-            ) % max(self._R, 1)
-            self._rot_cursor = (
-                self._rot_cursor + rot_block
-            ) % max(self._R, 1)
+            rot = self._rotation_rows()
             parts = [order, rot]
             if len(config_changed):
                 # Config rows at/above _R are padding; never deliver them.
                 parts.append(config_changed[config_changed < self._R])
             sel = np.unique(np.concatenate(parts))
         n_sel = len(sel)
+
+        if self._meshrows is not None:
+            return self._stage_mesh(
+                order, is_full, w, h, s, act, sel, now, ph
+            )
 
         kfill = self._kfill
         dtype = self._dtype
@@ -605,12 +774,109 @@ class ResidentDenseSolver:
             dispatched_at=now,
         )
 
+    def _stage_mesh(self, order, is_full, w, h, s, act, sel, now, ph):
+        """Mesh tail of dispatch(): group this tick's row scatters and
+        the delivery set by owning shard, stage per-shard blocks (the
+        sharded device_put moves only each shard's slice onto its
+        device — a dirty row's upload reaches the owning shard and no
+        other), run the shard_mapped tick, and start one download
+        stream per shard."""
+        from doorman_tpu.solver.resident_mesh import (
+            group_by_shard,
+            pad_shard_blocks,
+            pad_shard_indices,
+        )
+        from doorman_tpu.utils.transfer import start_sharded_download
+
+        mr = self._meshrows
+        n_dev = mr.n_dev
+        Rl = self._Rp // n_dev
+        kfill = self._kfill
+        dtype = self._dtype
+        n_sel = len(sel)
+
+        owner_a = order // Rl
+        counts_a, (a_idx_l, a_w_l) = group_by_shard(
+            owner_a, n_dev, [order - owner_a * Rl, w[:, :kfill]]
+        )
+        f_pos = np.nonzero(is_full)[0]
+        rows_f = order[f_pos]
+        owner_f = rows_f // Rl
+        counts_f, (f_idx_l, f_h_l, f_s_l, f_a_l) = group_by_shard(
+            owner_f, n_dev,
+            [
+                rows_f - owner_f * Rl, h[f_pos, :kfill],
+                s[f_pos, :kfill], act[f_pos, :kfill],
+            ],
+        )
+        # sel is sorted, so owners are nondecreasing and the stable
+        # grouping preserves sel's order exactly — the handle's global
+        # bookkeeping (rids/versions/keep) needs no permutation.
+        owner_sel = sel // Rl
+        counts_sel, (sel_l,) = group_by_shard(
+            owner_sel, n_dev, [sel - owner_sel * Rl]
+        )
+
+        Da = _ceil_to(int(counts_a.max()), 64)
+        Df = _ceil_to(int(counts_f.max()) if len(f_pos) else 1, 8)
+        Sb = _ceil_to(int(counts_sel.max()), 256)
+        a_idx_b, a_w_b = pad_shard_blocks(
+            counts_a, Da,
+            [(a_idx_l, Rl), (a_w_l.astype(dtype), 0)],
+        )
+        f_idx_b, f_h_b, f_s_b, f_a_b = pad_shard_blocks(
+            counts_f, Df,
+            [
+                (f_idx_l, Rl), (f_h_l.astype(dtype), 0),
+                (f_s_l.astype(dtype), 0), (f_a_l.astype(bool), False),
+            ],
+        )
+        f_block = np.stack([f_h_b, f_s_b], axis=1)  # [n_dev, 2, Df, k]
+        sel_b = pad_shard_indices(counts_sel, Sb, sel_l)
+        idx_host = np.concatenate(
+            [a_idx_b, f_idx_b, sel_b], axis=1
+        ).astype(np.int32)
+
+        itemsize = dtype.itemsize
+        ph.shard_bytes(
+            "upload",
+            counts_a * (kfill * itemsize + 4)
+            + counts_f * (kfill * (2 * itemsize + 1) + 4)
+            + counts_sel * 4,
+        )
+        ph.shard_bytes(
+            "download",
+            counts_sel * kfill * np.dtype(self._out_dtype).itemsize,
+        )
+        put = self._put_rows
+        tick = self._tick_fn_mesh(Da, Df, Sb)
+        staged = (put(idx_host), put(a_w_b), put(f_block), put(f_a_b))
+        ph.lap("upload")
+        idx_d, a_w_d, f_block_d, f_a_d = staged
+        (
+            self._wants, self._has, self._sub, self._act, out
+        ) = tick(
+            self._wants, self._has, self._sub, self._act,
+            idx_d, a_w_d, f_block_d, f_a_d,
+            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+        )
+        out = start_sharded_download(out)
+        ph.lap("solve")
+        return TickHandle(
+            out=out,
+            sel_rows=sel,
+            rids=self._rids[sel],
+            versions=self._uploaded_versions[sel],
+            keep_has=self._learn_h[sel].astype(np.uint8),
+            n_sel=n_sel,
+            dispatched_at=now,
+            shard_counts=counts_sel,
+        )
+
     def collect(self, handle: TickHandle) -> int:
         """Write one tick's downloaded grants back into the engine; rows
         whose membership moved mid-flight are skipped (they re-deliver
         next tick). Returns the rows applied."""
-        from doorman_tpu.utils.transfer import land_parts
-
         if handle.collected:
             return 0
         handle.collected = True
@@ -624,8 +890,7 @@ class ResidentDenseSolver:
         ph = PhaseRecorder("resident", self.phase_s)
         # Parts were split (and their async copies started) at
         # dispatch; land them in order into one buffer.
-        gets = land_parts(handle.out)
-        gets = np.asarray(gets, np.float64)[: handle.n_sel]
+        gets = landed_rows(handle)
         ph.lap("download")
         applied = self._engine.apply_dense(
             handle.rids,

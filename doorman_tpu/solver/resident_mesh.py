@@ -1,0 +1,158 @@
+"""Mesh sharding support for the device-resident tick solvers.
+
+The resident solvers (solver/resident.py, solver/resident_wide.py) keep
+the [R, W] demand tables on device and move only deltas across the host
+link.  With a `parallel.mesh` Mesh the same tables shard their ROW axis
+across every mesh axis — contiguous equal row blocks, one per device in
+mesh order — and each tick becomes a donated shard_map solve.  This
+module owns the host-side layout math both solvers share:
+
+  * `MeshRows` — the partition itself: padded row counts, the
+    NamedShardings for tables / per-shard staged blocks / replicated
+    config, and the per-shard rotation cursors (each shard walks its
+    OWN real rows, so every tick's delivery download is balanced
+    across shards instead of one contiguous window marching through
+    them);
+  * `group_by_shard` / `pad_shard_blocks` / `pad_shard_indices` — turn
+    a tick's global dirty-row (or dirty-slot) and delivery sets into
+    per-shard [n_dev, U] blocks.  Placed with the axis-0 sharding,
+    `jax.device_put` moves ONLY each shard's slice to its device: a
+    dirty slot's upload reaches the owning shard and no other.
+
+Per-shard blocks pad to one uniform width (the max across shards,
+bucketed) so compile variants stay bounded; padded scatter slots carry
+an out-of-range index and drop in the kernel (`mode="drop"`), padded
+gather slots repeat the shard's last index so sorted-gather hints stay
+truthful.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class MeshRows:
+    """Row-axis partition of a resident table over a Mesh: contiguous
+    row blocks, one per device (mesh axes flattened in order)."""
+
+    def __init__(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.mesh = mesh
+        self.axes = tuple(mesh.axis_names)
+        self.n_dev = int(np.prod([mesh.shape[a] for a in self.axes]))
+        self._named = NamedSharding
+        self._pspec = PartitionSpec
+        self._cache: dict = {}
+
+    def shard0(self, ndim: int):
+        """Axis 0 split over every mesh axis, trailing axes replicated:
+        the spec for the device tables ([Rp, W] rows) AND the staged
+        per-shard blocks ([n_dev, U, ...]) — both lead with a
+        device-count-divisible axis."""
+        s = self._cache.get(ndim)
+        if s is None:
+            spec = self._pspec(self.axes, *([None] * (ndim - 1)))
+            s = self._named(self.mesh, spec)
+            self._cache[ndim] = s
+        return s
+
+    def replicated(self):
+        """Fully replicated placement (per-segment config vectors)."""
+        s = self._cache.get("rep")
+        if s is None:
+            s = self._named(self.mesh, self._pspec())
+            self._cache["rep"] = s
+        return s
+
+    def round_rows(self, rp: int) -> int:
+        """Pad a row count so every shard holds an equal block."""
+        return -(-rp // self.n_dev) * self.n_dev
+
+    def rotation_rows(
+        self,
+        cursors: np.ndarray,
+        n_real: int,
+        rows_per_shard: int,
+        rotate: int,
+    ) -> np.ndarray:
+        """One tick's rotation slice, per-shard: shard d advances its
+        own cursor through its real rows (global rows [d*Rl, d*Rl+n_d)),
+        delivering ceil(n_d / rotate) of them — so the whole table is
+        covered every `rotate` ticks AND each shard's download stays
+        ~1/n_dev of the slice every tick.  Advances `cursors` in place.
+        Returns global row indices."""
+        parts: List[np.ndarray] = []
+        for d in range(self.n_dev):
+            lo = d * rows_per_shard
+            n_loc = min(max(n_real - lo, 0), rows_per_shard)
+            if n_loc <= 0:
+                break  # shards are filled front to back
+            block = -(-n_loc // max(rotate, 1))
+            rot = (
+                int(cursors[d]) + np.arange(block, dtype=np.int64)
+            ) % n_loc
+            cursors[d] = (int(cursors[d]) + block) % n_loc
+            parts.append(lo + rot)
+        if not parts:
+            return np.zeros(0, np.int64)
+        return np.concatenate(parts)
+
+
+def group_by_shard(
+    owner: np.ndarray, n_dev: int, arrays: Sequence[np.ndarray]
+) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Stable-partition parallel per-item arrays by owning shard.
+
+    Returns (counts [n_dev], arrays reordered shard-major).  The sort is
+    stable, so a globally sorted index column (owner nondecreasing, e.g.
+    a sorted delivery set) comes back in EXACTLY its input order — the
+    property that keeps a TickHandle's global row bookkeeping aligned
+    with the shard-major download blocks."""
+    owner = np.asarray(owner)
+    counts = np.bincount(owner, minlength=n_dev).astype(np.int64)
+    perm = np.argsort(owner, kind="stable")
+    return counts, [np.asarray(a)[perm] for a in arrays]
+
+
+def pad_shard_blocks(
+    counts: np.ndarray,
+    width: int,
+    arrays_fills: Sequence[Tuple[np.ndarray, object]],
+) -> List[np.ndarray]:
+    """Scatter shard-major packed rows into padded [n_dev, width, ...]
+    blocks (one fill value per array; index columns fill with an
+    out-of-range index so padded scatter slots drop in the kernel)."""
+    n_dev = len(counts)
+    outs: List[np.ndarray] = []
+    for arr, fill in arrays_fills:
+        arr = np.asarray(arr)
+        out = np.full((n_dev, width) + arr.shape[1:], fill, arr.dtype)
+        pos = 0
+        for d in range(n_dev):
+            c = int(counts[d])
+            out[d, :c] = arr[pos : pos + c]
+            pos += c
+        outs.append(out)
+    return outs
+
+
+def pad_shard_indices(
+    counts: np.ndarray, width: int, idx: np.ndarray
+) -> np.ndarray:
+    """Per-shard GATHER index blocks [n_dev, width], padded by
+    repeating the shard's last index — each block stays sorted (the
+    gathers pass indices_are_sorted) and always in range.  Empty shards
+    pad with 0; their gathered rows are sliced off at collect."""
+    idx = np.asarray(idx)
+    out = np.zeros((len(counts), width), idx.dtype)
+    pos = 0
+    for d in range(len(counts)):
+        c = int(counts[d])
+        if c:
+            out[d, :c] = idx[pos : pos + c]
+            out[d, c:] = idx[pos + c - 1]
+            pos += c
+    return out

@@ -62,7 +62,12 @@ from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
-from doorman_tpu.solver.resident import TickHandle, _ceil_to
+from doorman_tpu.solver.resident import (
+    TickHandle,
+    _ceil_to,
+    landed_rows,
+    place,
+)
 
 
 class WideResidentSolver:
@@ -80,6 +85,7 @@ class WideResidentSolver:
         *,
         dtype=np.float32,
         device=None,
+        mesh=None,
         clock: Callable[[], float] = time.time,
         rotate_ticks: "int | None" = None,
         tick_interval: "float | None" = None,
@@ -95,6 +101,20 @@ class WideResidentSolver:
         self._engine = engine
         self._dtype = np.dtype(dtype)
         self._device = device
+        # A parallel.mesh Mesh shards the chunk-row axis across every
+        # mesh axis. Wide resources' chunks SPAN shards, so the
+        # shard_mapped tick combines per-segment totals over ICI with
+        # the bit-stable psum reduction in
+        # parallel.sharded.resident_chunk_reduces — grants (and thus
+        # store contents) stay byte-identical to the single-device
+        # tick. `device` is ignored under a mesh.
+        self._mesh = mesh
+        self._meshrows = None
+        if mesh is not None:
+            from doorman_tpu.solver.resident_mesh import MeshRows
+
+            self._meshrows = MeshRows(mesh)
+        self._rot_shard_cursors: "np.ndarray | None" = None
         self._clock = clock
         self._W = int(chunk_width or DENSE_MAX_K)
         self._tick_interval = tick_interval
@@ -153,10 +173,23 @@ class WideResidentSolver:
         self._rotate_override = max(int(value), 1)
         self._rotate = self._rotate_override
 
-    def _put(self, arr):
-        import jax
+    def _put(self, arr, sharding=None):
+        return place(arr, device=self._device, sharding=sharding)
 
-        return jax.device_put(arr, self._device)
+    def _put_rows(self, arr):
+        """Row-axis placement: chunk-row tables and row_seg split over
+        the mesh, per-shard staged blocks split by their leading device
+        axis. Single-device put without a mesh."""
+        if self._meshrows is None:
+            return self._put(arr)
+        return self._put(arr, self._meshrows.shard0(np.ndim(arr)))
+
+    def _put_rep(self, arr):
+        """Per-SEGMENT config vectors: replicated on every mesh device
+        (each shard's solve reads all segment config)."""
+        if self._meshrows is None:
+            return self._put(arr)
+        return self._put(arr, self._meshrows.replicated())
 
     # -- config tracking (per SEGMENT; the narrow solver's per-row
     # equivalents are resident.py:194-274 — same cadence rules) --------
@@ -194,9 +227,9 @@ class WideResidentSolver:
                 ),
             )
         if self._kind_h is None or not np.array_equal(kind, self._kind_h):
-            self._kind_h, self._kind_d = kind, self._put(kind)
+            self._kind_h, self._kind_d = kind, self._put_rep(kind)
         if self._statc_h is None or not np.array_equal(statc, self._statc_h):
-            self._statc_h, self._statc_d = statc, self._put(statc)
+            self._statc_h, self._statc_d = statc, self._put_rep(statc)
 
     def _refresh_config(
         self, res: Sequence[Resource], config_epoch: int, now: float
@@ -221,9 +254,9 @@ class WideResidentSolver:
             mask = (cap != self._cap_h) | (learn != self._learn_h)
             changed = np.nonzero(mask)[0]
         if self._cap_h is None or not np.array_equal(cap, self._cap_h):
-            self._cap_h, self._cap_d = cap, self._put(cap)
+            self._cap_h, self._cap_d = cap, self._put_rep(cap)
         if self._learn_h is None or not np.array_equal(learn, self._learn_h):
-            self._learn_h, self._learn_d = learn, self._put(learn)
+            self._learn_h, self._learn_d = learn, self._put_rep(learn)
         return changed
 
     # -- build / rebuild ----------------------------------------------
@@ -243,6 +276,13 @@ class WideResidentSolver:
         self._R = int(self._base_row[-1])
         # +1 reserves a padding row for no-op scatters.
         self._Rp = _round_rows(self._R + 1)
+        if self._meshrows is not None:
+            # Equal chunk-row blocks per shard; fresh per-shard
+            # rotation cursors (the old ones indexed the old layout).
+            self._Rp = self._meshrows.round_rows(self._Rp)
+            self._rot_shard_cursors = np.zeros(
+                self._meshrows.n_dev, np.int64
+            )
         self._row_rids = np.full(self._Rp, -1, np.int32)
         self._row_chunk = np.full(self._Rp, -1, np.int32)
         # Padding rows resolve to the reserved padding segment Sp-1
@@ -272,11 +312,11 @@ class WideResidentSolver:
         )
         dtype = self._dtype
         pad = ((0, self._Rp - self._R), (0, 0))
-        self._wants = self._put(np.pad(w, pad).astype(dtype))
-        self._has = self._put(np.pad(h, pad).astype(dtype))
-        self._sub = self._put(np.pad(s, pad).astype(dtype))
-        self._act = self._put(np.pad(act, pad).astype(bool))
-        self._row_seg_d = self._put(self._row_seg_h)
+        self._wants = self._put_rows(np.pad(w, pad).astype(dtype))
+        self._has = self._put_rows(np.pad(h, pad).astype(dtype))
+        self._sub = self._put_rows(np.pad(s, pad).astype(dtype))
+        self._act = self._put_rows(np.pad(act, pad).astype(bool))
+        self._row_seg_d = self._put_rows(self._row_seg_h)
         self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
         self._cap_raw = None
         self._refresh_config(res, self._config_epoch, self._clock())
@@ -296,7 +336,119 @@ class WideResidentSolver:
                 return True
         return False
 
+    def _rotation_rows(self) -> np.ndarray:
+        """This tick's rotation slice (advances the cursor state); the
+        mesh path rotates per shard so each tick's delivery download is
+        balanced across shards (see ResidentDenseSolver._rotation_rows)."""
+        if self._meshrows is None:
+            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
+            rot = (
+                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
+            ) % max(self._R, 1)
+            self._rot_cursor = (
+                self._rot_cursor + rot_block
+            ) % max(self._R, 1)
+            return rot
+        return self._meshrows.rotation_rows(
+            self._rot_shard_cursors, self._R,
+            self._Rp // self._meshrows.n_dev, self.rotate_ticks,
+        )
+
     # -- the tick executable ------------------------------------------
+
+    def _tick_fn_mesh(self, Dw: int, Df: int, Sb: int):
+        """The shard_mapped chunked tick: tables and row_seg row-sharded
+        over the mesh, per-segment config replicated, staged slot
+        scatters pre-partitioned per shard (shard-LOCAL flat indices;
+        padded slots carry the out-of-range index Rl*W and drop).
+        Per-segment totals combine with the bit-stable psum reduction
+        (parallel.sharded.resident_chunk_reduces), so a resource whose
+        chunks straddle a shard boundary reduces to byte-identical
+        totals vs the single-device solve_chunked."""
+        key = (Dw, Df, Sb)
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.parallel.sharded import resident_chunk_reduces
+        from doorman_tpu.solver.lanes import solve_lanes
+
+        mr = self._meshrows
+        axes = mr.axes
+        Rp, W = self._Rp, self._W
+        Rl = Rp // mr.n_dev
+        out_dtype = self._out_dtype
+        # The full row->segment map is a compile-time constant of this
+        # executable (rebuilds clear _tick_fns): every shard runs the
+        # same segment op over the psum-assembled global row totals.
+        segsum, segmax = resident_chunk_reduces(
+            self._mesh, self._row_seg_h, self._Sp, Rl
+        )
+
+        def body(wants, has, sub, act, row_seg, w_idx, w_val, f_idx,
+                 f_w, f_h, f_s, f_a, sel_idx, cap, kind, learn, statc):
+            w_idx = w_idx[0]
+            f_idx = f_idx[0]
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val[0], mode="drop")
+                .at[f_idx].set(f_w[0], mode="drop")
+                .reshape(Rl, W)
+            )
+            has = (
+                has.reshape(-1).at[f_idx].set(f_h[0], mode="drop")
+                .reshape(Rl, W)
+            )
+            sub = (
+                sub.reshape(-1).at[f_idx].set(f_s[0], mode="drop")
+                .reshape(Rl, W)
+            )
+            act = (
+                act.reshape(-1).at[f_idx].set(f_a[0], mode="drop")
+                .reshape(Rl, W)
+            )
+            gets = solve_lanes(
+                wants, has, sub, act, cap, kind, learn, statc,
+                segsum=segsum, segmax=segmax,
+                expand=lambda totals: totals[row_seg][:, None],
+            )
+            out = jnp.take(
+                gets, sel_idx[0], axis=0, mode="clip",
+                indices_are_sorted=True,
+            ).astype(out_dtype)
+            return wants, gets, sub, act, out[None]
+
+        rowk = P(axes, None)
+        row = P(axes)
+        dev = P(axes, None)
+        rep = P()
+        mapped = shard_map(
+            body,
+            mesh=self._mesh,
+            in_specs=(
+                rowk, rowk, rowk, rowk,  # tables
+                row,  # row_seg (local block)
+                dev, dev,  # w_idx, w_val
+                dev, dev, dev, dev, dev,  # f_idx, f_w, f_h, f_s, f_a
+                dev,  # sel_idx
+                rep, rep, rep, rep,  # per-segment config
+            ),
+            out_specs=(rowk, rowk, rowk, rowk, P(axes, None, None)),
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(*args):
+            return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
 
     def _tick_fn(self, Dw: int, Df: int, Sb: int):
         key = (Dw, Df, Sb)
@@ -436,16 +588,13 @@ class WideResidentSolver:
         # explains why that bound is the reference's own staleness).
         full_mask = levels >= 2
         dirty_rows = flat_idx // W
-        rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
-        rot = (
-            self._rot_cursor + np.arange(rot_block, dtype=np.int64)
-        ) % max(self._R, 1)
+        rot = self._rotation_rows()
         if self._just_rebuilt or config_changed is None:
             self._just_rebuilt = False
             sel = np.arange(max(self._R, 1), dtype=np.int64)
         else:
             parts = [dirty_rows[full_mask], rot]
-            budget = max(64, 2 * rot_block)
+            budget = max(64, 2 * max(len(rot), 1))
             wants_rows = np.unique(dirty_rows[~full_mask])
             if len(wants_rows) <= budget:
                 parts.append(wants_rows)
@@ -454,27 +603,26 @@ class WideResidentSolver:
                     b, n = self._base_row[s], self._n_chunks[s]
                     parts.append(np.arange(b, b + n, dtype=np.int64))
             sel = np.unique(np.concatenate(parts))
-        self._rot_cursor = (self._rot_cursor + rot_block) % max(self._R, 1)
         n_sel = len(sel)
         sel_rids = self._row_rids[sel]
         sel_chunks = self._row_chunk[sel]
         # Versions BEFORE the pack (safe direction; see chunk_versions).
         versions = self._engine.chunk_versions(sel_rids, sel_chunks)
 
-        # Pack the dirty slots' values (one gather call per rid).
+        # Pack the dirty slots' values (one gather call per rid) into
+        # UNPADDED arrays; padding is per-path below (single device:
+        # one flat block aimed at the padding row; mesh: per-shard
+        # blocks with out-of-range drop slots).
         n_w = int((~full_mask).sum())
         n_f = int(full_mask.sum())
-        Dw = _ceil_to(n_w, 1024)
-        Df = _ceil_to(n_f, 256)
-        Sb = _ceil_to(n_sel, 32)
         dtype = self._dtype
-        w_idx = np.full(Dw, self._R * W, np.int64)  # padding row slot 0
-        w_val = np.zeros(Dw, dtype)
-        f_idx = np.full(Df, self._R * W, np.int64)
-        f_w = np.zeros(Df, dtype)
-        f_h = np.zeros(Df, dtype)
-        f_s = np.zeros(Df, dtype)
-        f_a = np.zeros(Df, bool)
+        w_idx = np.zeros(n_w, np.int64)
+        w_val = np.zeros(n_w, dtype)
+        f_idx = np.zeros(n_f, np.int64)
+        f_w = np.zeros(n_f, dtype)
+        f_h = np.zeros(n_f, dtype)
+        f_s = np.zeros(n_f, dtype)
+        f_a = np.zeros(n_f, bool)
         wpos = fpos = 0
         # One-tick UPLOAD-side inconsistency window: pack_slots reads
         # LIVE engine state, after the drain above. A swap-remove
@@ -509,14 +657,40 @@ class WideResidentSolver:
             f_s[fpos : fpos + nf_i] = psub[fm]
             f_a[fpos : fpos + nf_i] = pact[fm].astype(bool)
             fpos += nf_i
-        sel_pad = np.resize(sel, Sb) if n_sel else np.zeros(Sb, np.int64)
         lap("pack")
 
+        keep = np.zeros(n_sel, np.uint8)
+        if n_sel:
+            segs = self._row_seg_h[sel]
+            keep = self._learn_h[segs].astype(np.uint8)
+        if self._meshrows is not None:
+            return self._stage_mesh(
+                w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
+                sel, sel_rids, sel_chunks, versions, keep, now, ph,
+            )
+
+        Dw = _ceil_to(n_w, 1024)
+        Df = _ceil_to(n_f, 256)
+        Sb = _ceil_to(n_sel, 32)
+        pad_slot = self._R * W  # padding row slot 0
+
+        def padded(arr, width, fill):
+            out = np.full((width,) + arr.shape[1:], fill, arr.dtype)
+            out[: len(arr)] = arr
+            return out
+
+        sel_pad = np.resize(sel, Sb) if n_sel else np.zeros(Sb, np.int64)
         put = self._put
         tick = self._tick_fn(Dw, Df, Sb)
         staged = (
-            put(w_idx), put(w_val), put(f_idx), put(f_w), put(f_h),
-            put(f_s), put(f_a), put(sel_pad.astype(np.int32)),
+            put(padded(w_idx, Dw, pad_slot)),
+            put(padded(w_val, Dw, 0)),
+            put(padded(f_idx, Df, pad_slot)),
+            put(padded(f_w, Df, 0)),
+            put(padded(f_h, Df, 0)),
+            put(padded(f_s, Df, 0)),
+            put(padded(f_a, Df, False)),
+            put(sel_pad.astype(np.int32)),
         )
         lap("upload")
         (
@@ -530,10 +704,6 @@ class WideResidentSolver:
 
         out = start_download(out)
         lap("solve")
-        keep = np.zeros(n_sel, np.uint8)
-        if n_sel:
-            segs = self._row_seg_h[sel]
-            keep = self._learn_h[segs].astype(np.uint8)
         return TickHandle(
             out=out,
             sel_rows=sel,
@@ -545,12 +715,101 @@ class WideResidentSolver:
             chunks=sel_chunks,
         )
 
+    def _stage_mesh(self, w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
+                    sel, sel_rids, sel_chunks, versions, keep, now, ph):
+        """Mesh tail of dispatch(): slot scatters and the delivery set
+        grouped by owning shard; per-shard blocks land only on their
+        own device, the shard_mapped tick solves with the bit-stable
+        psum reduction, and the delivery downloads one stream per
+        shard (see ResidentDenseSolver._stage_mesh)."""
+        from doorman_tpu.solver.resident_mesh import (
+            group_by_shard,
+            pad_shard_blocks,
+            pad_shard_indices,
+        )
+        from doorman_tpu.utils.transfer import start_sharded_download
+
+        mr = self._meshrows
+        n_dev = mr.n_dev
+        W = self._W
+        Rl = self._Rp // n_dev
+        span = Rl * W
+        n_sel = len(sel)
+
+        ow = w_idx // span
+        counts_w, (w_idx_l, w_val_l) = group_by_shard(
+            ow, n_dev, [w_idx - ow * span, w_val]
+        )
+        of = f_idx // span
+        counts_f, (f_idx_l, f_w_l, f_h_l, f_s_l, f_a_l) = group_by_shard(
+            of, n_dev, [f_idx - of * span, f_w, f_h, f_s, f_a]
+        )
+        # sel is sorted, so owners are nondecreasing and the stable
+        # grouping preserves sel's order exactly — the handle's global
+        # bookkeeping (rids/chunks/versions/keep) needs no permutation.
+        owner_sel = sel // Rl
+        counts_sel, (sel_l,) = group_by_shard(
+            owner_sel, n_dev, [sel - owner_sel * Rl]
+        )
+
+        Dw = _ceil_to(int(counts_w.max()) if len(w_idx) else 1, 1024)
+        Df = _ceil_to(int(counts_f.max()) if len(f_idx) else 1, 256)
+        Sb = _ceil_to(int(counts_sel.max()) if n_sel else 1, 32)
+        w_idx_b, w_val_b = pad_shard_blocks(
+            counts_w, Dw, [(w_idx_l, span), (w_val_l, 0)]
+        )
+        f_idx_b, f_w_b, f_h_b, f_s_b, f_a_b = pad_shard_blocks(
+            counts_f, Df,
+            [
+                (f_idx_l, span), (f_w_l, 0), (f_h_l, 0), (f_s_l, 0),
+                (f_a_l, False),
+            ],
+        )
+        sel_b = pad_shard_indices(counts_sel, Sb, sel_l).astype(np.int32)
+
+        itemsize = self._dtype.itemsize
+        ph.shard_bytes(
+            "upload",
+            counts_w * (8 + itemsize)
+            + counts_f * (8 + 3 * itemsize + 1)
+            + counts_sel * 4,
+        )
+        ph.shard_bytes(
+            "download",
+            counts_sel * W * np.dtype(self._out_dtype).itemsize,
+        )
+        put = self._put_rows
+        tick = self._tick_fn_mesh(Dw, Df, Sb)
+        staged = (
+            put(w_idx_b), put(w_val_b), put(f_idx_b), put(f_w_b),
+            put(f_h_b), put(f_s_b), put(f_a_b), put(sel_b),
+        )
+        ph.lap("upload")
+        (
+            self._wants, self._has, self._sub, self._act, out
+        ) = tick(
+            self._wants, self._has, self._sub, self._act,
+            self._row_seg_d, *staged,
+            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+        )
+        out = start_sharded_download(out)
+        ph.lap("solve")
+        return TickHandle(
+            out=out,
+            sel_rows=sel,
+            rids=sel_rids,
+            versions=versions,
+            keep_has=keep,
+            n_sel=n_sel,
+            dispatched_at=now,
+            chunks=sel_chunks,
+            shard_counts=counts_sel,
+        )
+
     def collect(self, handle: TickHandle) -> int:
         """Write one tick's downloaded grant rows back into the engine;
         chunks whose membership version moved mid-flight are skipped
         (their re-marked slots re-deliver them next tick)."""
-        from doorman_tpu.utils.transfer import land_parts
-
         if handle.collected:
             return 0
         handle.collected = True
@@ -560,8 +819,7 @@ class WideResidentSolver:
             self.last_tick_seconds = self._clock() - handle.dispatched_at
             return 0
         ph = PhaseRecorder("resident_wide", self.phase_s)
-        gets = land_parts(handle.out)
-        gets = np.asarray(gets, np.float64)[: handle.n_sel]
+        gets = landed_rows(handle)
         ph.lap("download")
         applied = self._engine.apply_chunks(
             handle.rids,

@@ -66,6 +66,32 @@ def start_download(arr, *, chunks: "int | None" = None,
     return parts
 
 
+def start_sharded_download(arr) -> list:
+    """Per-shard async downloads of a leading-axis device-sharded
+    array: one part per shard, ordered by leading-axis offset, so
+    `land_parts` reassembles the full [n_dev, ...] block.  Each part is
+    a shard's own device buffer — no cross-device reshuffle, and every
+    device's host link streams its slice concurrently.  Falls back to
+    `start_download` when the array is not sharded (single-device
+    resident path)."""
+    try:
+        shards = list(arr.addressable_shards)
+    except Exception:
+        return start_download(arr)
+    if len(shards) <= 1:
+        return start_download(arr)
+    parts = [
+        s.data
+        for s in sorted(shards, key=lambda s: s.index[0].start or 0)
+    ]
+    try:
+        for p in parts:
+            p.copy_to_host_async()
+    except Exception:
+        pass
+    return parts
+
+
 def chunked_device_get(
     arr, *, chunks: int = 8, min_bytes: int = 1 << 20
 ) -> np.ndarray:
