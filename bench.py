@@ -860,6 +860,117 @@ def bench_server_tick_wide_mesh() -> None:
     )
 
 
+# server_rpc_storm: concurrent closed-loop GetCapacity clients against
+# the real immediate-mode server over loopback gRPC, admission off vs
+# on (doorman_tpu.admission).
+STORM_WORKERS = 48
+STORM_SECONDS = 2.0
+STORM_CALIB_SECONDS = 0.8
+# Saturation gate: the admission-off p99 must be at least this multiple
+# of the single-worker p50, or the storm never actually queued on the
+# event loop and the off/on comparison is meaningless — that run emits
+# a diagnostic, never a metric row (BENCH_r05 convention).
+STORM_SATURATION_FACTOR = 3.0
+
+
+def bench_server_rpc_storm() -> None:
+    """RPC goodput and tail latency under a client storm, admission off
+    vs on.
+
+    The real immediate-mode CapacityServer serves loopback gRPC while
+    STORM_WORKERS closed-loop clients (pinned round-robin to three
+    priority bands) hammer GetCapacity as fast as responses return —
+    the front-door failure mode the admission subsystem exists for. A
+    single-worker calibration pins the unloaded p50; the admission-off
+    storm must push p99 past STORM_SATURATION_FACTOR x that, proving
+    real queueing, before any metric row is emitted. The admission-on
+    phase runs a fresh server with coalescing plus an offered-load
+    budget set to 70% of the measured admission-off goodput, so the
+    controller has real headroom to defend; storm workers honor
+    retry-after with jitter exactly like the production client."""
+    import asyncio
+
+    from doorman_tpu.loadtest.storm import run_storm
+    from doorman_tpu.server.config import parse_yaml_config
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    config = parse_yaml_config(
+        "resources:\n"
+        '- identifier_glob: "*"\n'
+        "  capacity: 1000000\n"
+        "  algorithm: {kind: FAIR_SHARE, lease_length: 60,\n"
+        "              refresh_interval: 1, learning_mode_duration: 0}\n"
+    )
+
+    async def storm_phase(admission, workers, seconds):
+        server = CapacityServer(
+            "storm-bench", TrivialElection(), mode="immediate",
+            minimum_refresh_interval=0.0, admission=admission,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(config)
+        await asyncio.sleep(0)  # election callbacks land
+        try:
+            return await run_storm(
+                f"127.0.0.1:{port}", "storm", workers=workers,
+                duration=seconds, bands=(0, 1, 2), seed=7,
+            )
+        finally:
+            await server.stop()
+
+    async def run():
+        calib = await storm_phase(None, 1, STORM_CALIB_SECONDS)
+        off = await storm_phase(None, STORM_WORKERS, STORM_SECONDS)
+        floor = STORM_SATURATION_FACTOR * max(calib["p50_s"], 1e-6)
+        if off["p99_s"] < floor or off["ok"] == 0:
+            # The storm never queued (a fast box, a tiny worker count,
+            # a broken loopback): report why, emit NO metric rows.
+            diagnostic({
+                "diagnostic": "storm_unsaturated",
+                "note": (
+                    f"admission-off p99 {off['p99_s'] * 1000:.2f} ms "
+                    f"below the saturation floor {floor * 1000:.2f} ms "
+                    f"({STORM_SATURATION_FACTOR}x unloaded p50 "
+                    f"{calib['p50_s'] * 1000:.2f} ms); storm stats: "
+                    f"{off}"
+                ),
+            })
+            return
+        from doorman_tpu.admission import Admission
+
+        admission = Admission(
+            coalesce_window=0.005,
+            window=0.25,
+            max_rps=off["goodput_qps"] * 0.7,
+        )
+        on = await storm_phase(admission, STORM_WORKERS, STORM_SECONDS)
+        emit({
+            "metric": "server_rpc_storm_goodput_qps_admission_off",
+            "value": off["goodput_qps"],
+            "unit": "qps",
+            "p50_ms": round(off["p50_s"] * 1000, 3),
+            "p99_ms": round(off["p99_s"] * 1000, 3),
+            "workers": STORM_WORKERS,
+        })
+        emit(
+            {
+                "metric": "server_rpc_storm_goodput_qps_admission_on",
+                "value": on["goodput_qps"],
+                "unit": "qps",
+                "p50_ms": round(on["p50_s"] * 1000, 3),
+                "p99_ms": round(on["p99_s"] * 1000, 3),
+                "shed": on["shed"],
+                "p99_vs_admission_off": round(
+                    off["p99_s"] / max(on["p99_s"], 1e-9), 3
+                ),
+            },
+            artifact_extra={"off": off, "on": on, "calibration": calib},
+        )
+
+    asyncio.run(run())
+
+
 def gate_pallas_kernels() -> None:
     """Real-TPU pallas regression gate: compile and run BOTH pallas
     kernels (dense lanes + banded priority water-fill) on the chip and
@@ -1067,6 +1178,9 @@ if __name__ == "__main__":
         # After the 1-device wide bench, so scaling_vs_1device can read
         # its median from this run's emitted results.
         bench_server_tick_wide_mesh()
+        # RPC front-end under storm (no device work; rides along so
+        # admission regressions show in the same artifact).
+        bench_server_rpc_storm()
         # The narrow server tick stays LAST: the driver parses the final
         # JSON line as the round's headline metric.
         bench_server_tick()

@@ -1,0 +1,196 @@
+"""Micro-batching front-end for GetCapacity.
+
+Concurrent GetCapacity RPCs park their futures into a grid-aligned
+window (every window is anchored to the coalescer's start, so requests
+arriving together resolve together instead of each starting its own
+timer) and the whole window resolves with ONE grouped decision pass.
+The pass groups parked work per resource and replays each resource's
+requests in arrival order, so it is BYTE-IDENTICAL to running the same
+stream through the per-request handler path:
+
+  * `_decide` for different resources touches disjoint stores — only
+    the per-resource order matters, and that is preserved;
+  * `safe_capacity()` reads only its own resource's store and is
+    computed immediately after each decide, exactly where the
+    per-request path computes it.
+
+tests/test_admission.py pins this parity (responses and stores, Python
+and native engines, mixed bands and `has`-carrying refreshes).
+
+Threading: the grouped pass leaves the event loop only when that is
+safe — the native engine's mutex guards concurrent RPC writes, but the
+persistence journal is documented loop-only (persist/__init__.py), so
+the executor is used iff the server runs the native store WITHOUT
+persistence. Python stores (and persisting servers) run the pass on the
+loop: still one scheduling point for the whole window, which is the
+actual win — O(windows) loop wakeups instead of O(requests).
+
+``window <= 0`` disables parking: submit() runs a one-request batch
+inline through the same grouped pass (same code path, same counters),
+which keeps the chaos runner's stepped schedule synchronous.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+from doorman_tpu.algorithms import Request
+from doorman_tpu.obs import trace as trace_mod
+from doorman_tpu.proto import doorman_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Coalescer"]
+
+
+class Coalescer:
+    def __init__(
+        self,
+        server,
+        *,
+        window: float,
+        on_window: Optional[Callable[[int, float], None]] = None,
+    ):
+        """`server` is the owning CapacityServer; `on_window(occupancy,
+        seconds)` fires after each resolved window (metrics hook)."""
+        self.server = server
+        self.window = float(window)
+        self._on_window = on_window
+        self._pending: List[Tuple[pb.GetCapacityRequest, asyncio.Future]] = []
+        self._flush_handle = None
+        self._anchor = time.monotonic()
+        self.flushes = 0
+        self.coalesced_requests = 0  # requests that shared a window
+        self.max_occupancy = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    async def submit(
+        self, request: pb.GetCapacityRequest
+    ) -> pb.GetCapacityResponse:
+        if self.window <= 0:
+            return (await self._resolve([(request, None)]))[0]
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((request, fut))
+        if self._flush_handle is None:
+            # Grid alignment: fire at the next window boundary since
+            # the anchor, not `window` after THIS arrival — late
+            # arrivals in a window ride the same flush.
+            elapsed = time.monotonic() - self._anchor
+            delay = self.window - (elapsed % self.window)
+            self._flush_handle = loop.call_later(delay, self._flush)
+        return await fut
+
+    def _flush(self) -> None:
+        self._flush_handle = None
+        batch, self._pending = self._pending, []
+        if batch:
+            asyncio.ensure_future(self._resolve_parked(batch))
+
+    async def _resolve_parked(self, batch) -> None:
+        try:
+            outs = await self._resolve(batch)
+        except Exception as e:
+            log.exception("coalesced decision pass failed")
+            for _, fut in batch:
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), out in zip(batch, outs):
+            if fut is not None and not fut.done():
+                fut.set_result(out)
+
+    async def _resolve(self, batch) -> List[pb.GetCapacityResponse]:
+        server = self.server
+        start = time.monotonic()
+        n = len(batch)
+        with trace_mod.default_tracer().span(
+            "admission.window", cat="admission",
+            args={
+                "server": server.id, "occupancy": n,
+                "resources": len(
+                    {rr.resource_id for req, _ in batch
+                     for rr in req.resource}
+                ),
+            },
+        ):
+            if not server.is_master:
+                # A flip while parked: every parked request gets the
+                # redirect it would have gotten from the handler.
+                outs = []
+                for _ in batch:
+                    out = pb.GetCapacityResponse()
+                    out.mastership.CopyFrom(server._mastership())
+                    outs.append(out)
+            else:
+                # Resources are created ON the loop before any executor
+                # hop, so the grouped pass never races get-or-create
+                # against other handlers.
+                for req, _ in batch:
+                    for rr in req.resource:
+                        server.get_or_create_resource(rr.resource_id)
+                if server._native_store and server._persist is None:
+                    ctx = contextvars.copy_context()
+                    outs = await asyncio.get_running_loop().run_in_executor(
+                        None, ctx.run, self._decide_batch, batch
+                    )
+                else:
+                    outs = self._decide_batch(batch)
+        seconds = time.monotonic() - start
+        self.flushes += 1
+        self.max_occupancy = max(self.max_occupancy, n)
+        if n > 1:
+            self.coalesced_requests += n
+        if self._on_window is not None:
+            self._on_window(n, seconds)
+        return outs
+
+    def _decide_batch(self, batch) -> List[pb.GetCapacityResponse]:
+        """The grouped decision pass (see module docstring for the
+        parity argument). May run on the loop or in the executor."""
+        server = self.server
+        slots: List[List] = [
+            [None] * len(req.resource) for req, _ in batch
+        ]
+        groups: dict = {}
+        for bi, (req, _) in enumerate(batch):
+            for ri, rr in enumerate(req.resource):
+                groups.setdefault(rr.resource_id, []).append((bi, ri, rr))
+        for resource_id, entries in groups.items():
+            for bi, ri, rr in entries:
+                req = batch[bi][0]
+                has = rr.has.capacity if rr.HasField("has") else 0.0
+                lease, res = server._decide(
+                    resource_id,
+                    Request(req.client_id, has, rr.wants, 1,
+                            priority=rr.priority),
+                )
+                slots[bi][ri] = (lease, res.safe_capacity())
+        outs = []
+        for (req, _), row in zip(batch, slots):
+            out = pb.GetCapacityResponse()
+            for rr, (lease, safe) in zip(req.resource, row):
+                resp = out.response.add()
+                resp.resource_id = rr.resource_id
+                resp.gets.expiry_time = int(lease.expiry)
+                resp.gets.refresh_interval = int(lease.refresh_interval)
+                resp.gets.capacity = lease.has
+                resp.safe_capacity = safe
+            outs.append(out)
+        return outs
+
+    def status(self) -> dict:
+        return {
+            "window_s": self.window,
+            "queue_depth": self.queue_depth,
+            "flushes": self.flushes,
+            "coalesced_requests": self.coalesced_requests,
+            "max_occupancy": self.max_occupancy,
+        }

@@ -21,6 +21,15 @@ Four families, each with its documented slack:
   * reconvergence (checked by the runner): after the plan heals, client
     allocations return to the fault-free baseline within the plan's
     reconverge budget.
+  * admission (admission-enabled plans): the shed matrix is law —
+    ReleaseCapacity and GetServerCapacity are NEVER shed (a shed
+    release pins a dead client's capacity; a shed server aggregate
+    starves a whole subtree), and the top priority band's GetCapacity
+    shed count stays zero whenever lower bands exist (the goodput
+    floor: overload shedding walks UP from the bottom band and never
+    reaches the top while there is anything below it to shed).
+    Deadline fast-fails are excluded — a request that brought too
+    short a deadline was refused on its own terms, not the band's.
   * warm restore (persistence-enabled plans): every master takeover that
     restored state must land capacity-safe — per restored resource,
     sum(restored grants) <= the live capacity at restore (no learning
@@ -77,6 +86,9 @@ class InvariantChecker:
         # Restore summaries already validated (by object identity: a
         # server keeps one summary per takeover).
         self._checked_restores: set = set()
+        # Admission tallies only grow; report each offending key once,
+        # not once per subsequent tick.
+        self._reported_admission: set = set()
 
     # -- per-tick entry point ------------------------------------------
 
@@ -91,8 +103,50 @@ class InvariantChecker:
         out += self._check_single_master(tick, servers, election_groups)
         out += self._check_capacity(tick, servers)
         out += self._check_restores(tick, servers)
+        out += self._check_admission(tick, servers)
         self._record_grants(servers)
         out += self._check_lag_never_lead(tick, clients)
+        return out
+
+    # -- admission ------------------------------------------------------
+
+    def _check_admission(self, tick, servers) -> List[Violation]:
+        out = []
+        for name, server in servers.items():
+            adm = getattr(server, "_admission", None)
+            if adm is None:
+                continue
+            gc_bands = [
+                band for (method, band) in adm.tallies
+                if method == "GetCapacity"
+            ]
+            top = max(gc_bands) if gc_bands else None
+            for (method, band), counts in adm.tallies.items():
+                if counts["shed"] == 0:
+                    continue
+                key = (name, method, band)
+                if key in self._reported_admission:
+                    continue
+                if method in ("ReleaseCapacity", "GetServerCapacity"):
+                    self._reported_admission.add(key)
+                    out.append(Violation(
+                        tick, "releases_never_shed",
+                        f"{name}/{method}",
+                        f"{counts['shed']} {method} RPC(s) shed — the "
+                        "shed matrix forbids shedding this method",
+                    ))
+                elif (
+                    method == "GetCapacity"
+                    and band == top
+                    and len(set(gc_bands)) > 1
+                ):
+                    self._reported_admission.add(key)
+                    out.append(Violation(
+                        tick, "top_band_floor", f"{name}/band{band}",
+                        f"top band {band} shed {counts['shed']} "
+                        "request(s) while lower bands existed to shed "
+                        "first",
+                    ))
         return out
 
     # -- warm restore ---------------------------------------------------

@@ -45,6 +45,11 @@ KINDS = frozenset(
         # host seam
         "port_bind",        # action: bind a loopback port (stale server)
         "backend_probe_fail",  # utils.backend probe argv fails
+        # client seam (driven by the runner, not an injector): a swarm
+        # of extra clients hammers GetCapacity refreshes every tick
+        # while active; params: {"clients": n, "wants": w,
+        # "priority": band}. Storm clients release on heal.
+        "client_storm",
     }
 )
 

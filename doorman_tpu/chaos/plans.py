@@ -186,9 +186,58 @@ def master_flap_warm() -> FaultPlan:
     )
 
 
+def client_storm() -> FaultPlan:
+    """A refresh storm from a swarm of low-band clients against an
+    admission-enabled master. Three baseline clients sit in three
+    priority bands on a PRIORITY_BANDS resource; at the storm tick, 20
+    extra band-0 clients start hammering refreshes every tick — an
+    offered load ~8x the controller's max_rps budget. Expect: the
+    hard per-window cap sheds most of the swarm in its very first
+    window (before the AIMD level has a boundary to move at), the
+    level then collapses and band probabilities extinguish bottom-up
+    (band 0 first, band 1 next, the top band NEVER — the goodput-floor
+    invariant), baseline allocations ride through byte-unchanged (shed
+    refreshes retain leases; the admitted slice of the swarm only gets
+    band-0 leftovers under PRIORITY_BANDS), the swarm's releases at
+    heal all pass (releases-never-shed), and post-heal additive
+    recovery readmits every band with ticks to spare inside the
+    reconverge budget."""
+    return FaultPlan(
+        name="client_storm",
+        seed=6,
+        setup={
+            "servers": 1,
+            "clients": 3,
+            "wants": [20.0, 30.0, 40.0],
+            # Wire priorities: c0 is the top band the floor protects.
+            "priorities": [2, 1, 0],
+            "capacity": 100,
+            "algorithm": "PRIORITY_BANDS",
+            "mode": "immediate",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+            # One admit window per tick; 10 rps against 3 rps of
+            # baseline traffic — the swarm alone trips the budget.
+            "admission": {"max_rps": 10.0, "window": 1.0},
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="client_storm",
+                       duration_ticks=6,
+                       params={"clients": 20, "wants": 10.0,
+                               "priority": 0}),
+        ],
+        warmup_ticks=8,
+        total_ticks=28,
+        reconverge_ticks=12,
+    )
+
+
 PLANS: Dict[str, "callable"] = {
     "master_flap": master_flap,
     "master_flap_warm": master_flap_warm,
+    "client_storm": client_storm,
     "etcd_brownout": etcd_brownout,
     "device_tunnel_outage": device_tunnel_outage,
     "intermediate_partition": intermediate_partition,

@@ -143,6 +143,12 @@ class ChaosRunner:
         self.proxies: Dict[str, ChaosGrpcProxy] = {}
         self.elections: Dict[str, SteppedElection] = {}
         self.clients: List[Client] = []
+        # Storm swarm (client_storm events): created when the storm
+        # arms, refreshed every storm tick AFTER the base clients,
+        # closed (releasing) when it clears.
+        self.storm_clients: List[Client] = []
+        self._attach: str = ""
+        self._admission_last: Dict[str, tuple] = {}
         self.kv: Optional[InMemoryKV] = None
         # Shared persistence backend (setup["persist"]): every election
         # candidate snapshots/journals to the SAME store, modeling the
@@ -219,6 +225,20 @@ class ChaosRunner:
                     flush_interval=self.plan.tick_interval,
                     clock=self.clock,
                 )
+            admission = None
+            if s.get("admission"):
+                from doorman_tpu.admission import Admission
+
+                a = dict(s["admission"])
+                # The plan's seeded RNG is the run's ONLY randomness
+                # (FaultState docstring); admission's admit draws come
+                # from it so shed decisions replay byte-identically.
+                admission = Admission(
+                    coalesce_window=float(a.pop("coalesce_window", 0.0)),
+                    clock=self.clock,
+                    rng=self.state.rng,
+                    **a,
+                )
             server = CapacityServer(
                 proxy.address, election,
                 mode=s.get("mode", "immediate"),
@@ -227,6 +247,7 @@ class ChaosRunner:
                 clock=self.clock,
                 native_store=bool(s.get("native_store", False)),
                 persist=persist,
+                admission=admission,
             )
             SolverInjector(self.state, name).install(server)
             await server.start(0, host="127.0.0.1")
@@ -269,16 +290,18 @@ class ChaosRunner:
         wants = s.get("wants") or [
             10.0 * (i + 1) for i in range(int(s.get("clients", 3)))
         ]
-        for i, w in enumerate(wants):
+        priorities = s.get("priorities") or [0] * len(wants)
+        self._attach = attach
+        for i, (w, p) in enumerate(zip(wants, priorities)):
             client = Client(
                 attach, f"c{i}", minimum_refresh_interval=0.0,
                 max_retries=0, clock=self.clock,
             )
-            await client.resource(RESOURCE, float(w))
+            await client.resource(RESOURCE, float(w), priority=int(p))
             self.clients.append(client)
 
     async def _teardown(self) -> None:
-        for client in self.clients:
+        for client in self.clients + self.storm_clients:
             try:
                 await client.close()
             except Exception:
@@ -324,6 +347,65 @@ class ChaosRunner:
                 tick, "restore", name, lr["mode"],
                 lr["leases_restored"], bool(lr["clean_down"]), learning,
             ])
+
+    async def _drive_storm(self, tick: int) -> None:
+        """The client_storm seam: while the event is active, a swarm of
+        extra clients refreshes every tick (after the base clients, so
+        the baseline population is first through each admission
+        window); when it clears, the swarm closes — releasing its
+        leases through the never-shed ReleaseCapacity path."""
+        params = self.state.active("client_storm", "*")
+        if params is not None:
+            if not self.storm_clients:
+                n = int(params.get("clients", 10))
+                wants = float(params.get("wants", 10.0))
+                priority = int(params.get("priority", 0))
+                for i in range(n):
+                    client = Client(
+                        self._attach, f"storm{i}",
+                        minimum_refresh_interval=0.0,
+                        max_retries=0, clock=self.clock,
+                    )
+                    await client.resource(
+                        RESOURCE, wants, priority=priority
+                    )
+                    self.storm_clients.append(client)
+                self.log.append([tick, "storm_start", n])
+            admitted = 0
+            for client in self.storm_clients:
+                if await client.refresh_once():
+                    admitted += 1
+            self.log.append(
+                [tick, "storm", admitted, len(self.storm_clients)]
+            )
+        elif self.storm_clients:
+            swarm, self.storm_clients = self.storm_clients, []
+            for client in swarm:
+                await client.close()
+            self.log.append([tick, "storm_end", len(swarm)])
+
+    def _log_admission(self, tick: int) -> None:
+        """One deterministic event-log entry per server per tick where
+        admission activity moved: GetCapacity admitted/shed deltas plus
+        the controller level (rounded — the level is exact binary
+        arithmetic on plan constants)."""
+        for name, server in self.servers.items():
+            adm = getattr(server, "_admission", None)
+            if adm is None:
+                continue
+            admitted = shed = 0
+            for (method, _band), counts in adm.tallies.items():
+                if method == "GetCapacity":
+                    admitted += counts["admitted"]
+                    shed += counts["shed"]
+            last = self._admission_last.get(name, (0, 0))
+            if (admitted, shed) != last:
+                self._admission_last[name] = (admitted, shed)
+                self.log.append([
+                    tick, "admission", name,
+                    admitted - last[0], shed - last[1],
+                    round(adm.controller.level, 6),
+                ])
 
     def _snapshot(self) -> Dict[str, float]:
         return {
@@ -391,6 +473,9 @@ class ChaosRunner:
                 for client in self.clients:
                     await client.refresh_once()
 
+                await self._drive_storm(tick)
+                self._log_admission(tick)
+
                 # The durability beat (journal flush + cadenced
                 # snapshot) runs AFTER the tick's refreshes so this
                 # tick's decides are on disk before the next tick — the
@@ -399,7 +484,12 @@ class ChaosRunner:
                     server.persist_step()
 
                 for v in checker.check_tick(
-                    tick, self.servers, groups, self.clients
+                    tick, self.servers, groups,
+                    # Active storm clients are checked too: an admitted
+                    # storm lease is subject to lag-never-lead like any
+                    # other (baseline/convergence snapshots stay on the
+                    # base population only).
+                    self.clients + self.storm_clients,
                 ):
                     self._record_violation(v)
                     self.log.append([tick] + v.as_log())
@@ -444,6 +534,19 @@ class ChaosRunner:
         log_bytes = json.dumps(
             self.log, sort_keys=True, separators=(",", ":")
         ).encode()
+        # Final admission tallies per server (None when no server runs
+        # the admission front-end): deterministic integers the storm
+        # assertions read band by band.
+        admission_tallies = {
+            name: {
+                f"{method}/{band}": dict(counts)
+                for (method, band), counts in sorted(
+                    server._admission.tallies.items()
+                )
+            }
+            for name, server in self.servers.items()
+            if getattr(server, "_admission", None) is not None
+        } or None
         return {
             "plan": plan.name,
             "seed": plan.seed,
@@ -457,6 +560,7 @@ class ChaosRunner:
                 None if converged_at is None else converged_at - heal_tick
             ),
             "violations": [v.as_log() for v in self.violations],
+            "admission": admission_tallies,
             "event_log": self.log,
             "log_sha256": hashlib.sha256(log_bytes).hexdigest(),
         }

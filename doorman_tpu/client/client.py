@@ -14,10 +14,14 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import socket
 import time
 from typing import Callable, Dict, Optional
 
+import grpc
+
+from doorman_tpu.admission.policy import RETRY_AFTER_KEY
 from doorman_tpu.client.connection import Connection
 from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.proto import doorman_pb2 as pb
@@ -131,6 +135,11 @@ class Client:
         self._wake = asyncio.Event()
         self._closed = False
         self._task: Optional[asyncio.Task] = None
+        # Private jitter stream for retry pacing (full jitter on the
+        # backoff ladder; half-jitter on server retry-after hints) —
+        # decorrelates the fleet's retry waves. Private so nothing
+        # else's draws interleave with it.
+        self._retry_rng = random.Random()
         # Metrics hook (method, duration_s, error); the obs module's
         # instrument_client replaces this (reference client.go:87-99).
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
@@ -213,6 +222,18 @@ class Client:
 
     # ------------------------------------------------------------------
 
+    def _retry_after_hint(self, error) -> float:
+        """The server's retry-after hint (seconds) from a shed RPC's
+        trailing metadata; falls back to the refresh floor when the
+        metadata is missing or unreadable."""
+        try:
+            for key, value in error.trailing_metadata() or ():
+                if key == RETRY_AFTER_KEY:
+                    return max(float(value), 0.1)
+        except Exception:
+            pass
+        return max(self.conn.minimum_refresh_interval, MIN_BACKOFF)
+
     async def _run(self) -> None:
         """Main loop: wake on a new resource or when the shortest refresh
         interval elapses; refresh everything in one bulk RPC
@@ -279,6 +300,7 @@ class Client:
             else max(1.0, min(REFRESH_RPC_BOUND, soonest - now))
         )
         start = time.monotonic()
+        shed_after: Optional[float] = None
         try:
             # Metadata resolves inside the lambda, per attempt, under
             # the RPC span — retries re-send the current context.
@@ -294,6 +316,20 @@ class Client:
                     timeout=bound,
                 )
             failed = False
+        except grpc.aio.AioRpcError as e:
+            failed = True
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # The server's admission control shed this refresh and
+                # told us when to come back; leases are retained (they
+                # outlive a missed refresh by design) and the hint
+                # replaces the backoff ladder.
+                shed_after = self._retry_after_hint(e)
+                log.warning(
+                    "%s: refresh shed by the server; retrying in ~%.1fs",
+                    self.id, shed_after,
+                )
+            else:
+                log.exception("%s: GetCapacity failed", self.id)
         except Exception:
             log.exception("%s: GetCapacity failed", self.id)
             failed = True
@@ -320,8 +356,19 @@ class Client:
                     res.lease = None
                     res._fallback_capacity = fallback
                     res._push_capacity(fallback)
+            if shed_after is not None:
+                # Honor the retry-after hint with half jitter: at least
+                # half the hint, plus a uniform draw over the other
+                # half — the shed wave must not re-synchronize into
+                # the next storm (doc/admission.md).
+                return (
+                    0.5 * shed_after
+                    + self._retry_rng.uniform(0.0, 0.5 * shed_after),
+                    retry_number + 1,
+                )
             return (
-                backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number),
+                backoff(MIN_BACKOFF, MAX_BACKOFF, retry_number,
+                        jitter=self._retry_rng),
                 retry_number + 1,
             )
 

@@ -30,6 +30,12 @@ _NON_RETRIABLE = frozenset(
         grpc.StatusCode.UNIMPLEMENTED,
         grpc.StatusCode.PERMISSION_DENIED,
         grpc.StatusCode.UNAUTHENTICATED,
+        # The server's admission control explicitly shed this RPC with
+        # a retry-after hint in trailing metadata; hammering retries
+        # inside one execute() call would defeat the shedding — the
+        # caller owns the pacing (client.py honors the hint with
+        # jitter).
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
     }
 )
 

@@ -95,6 +95,25 @@ def make_parser() -> argparse.ArgumentParser:
                         "count). Initializes the JAX backend at "
                         "startup; store contents stay bit-identical "
                         "to the single-device tick (doc/parallel.md)")
+    p.add_argument("--admission", action="store_true",
+                   help="enable the RPC admission front-end: coalesced "
+                        "GetCapacity decisions, AIMD overload shedding "
+                        "by priority band (lowest bands first; never "
+                        "ReleaseCapacity/GetServerCapacity), and "
+                        "deadline fast-fail; shed responses carry a "
+                        "doorman-retry-after hint (doc/admission.md)")
+    p.add_argument("--coalesce-window", type=float, default=0.005,
+                   help="admission: seconds per micro-batch window — "
+                        "concurrent GetCapacity RPCs in a window "
+                        "resolve with one grouped decision pass "
+                        "(byte-identical to per-request; 0 disables "
+                        "coalescing but keeps shedding)")
+    p.add_argument("--admission-max-rps", type=float, default=0.0,
+                   help="admission: hard offered-load budget in "
+                        "requests/second — arrivals past it shed "
+                        "within the window; 0 leaves overload "
+                        "detection to the latency/queue/tick-lag "
+                        "signals alone")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -163,6 +182,20 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
             dict(mesh.shape), mesh.devices.size,
         )
 
+    admission = None
+    if args.admission:
+        from doorman_tpu.admission import Admission
+
+        admission = Admission(
+            coalesce_window=args.coalesce_window,
+            max_rps=args.admission_max_rps or None,
+        )
+        log.info(
+            "admission control enabled (coalesce window %.3fs, "
+            "max rps %s)", args.coalesce_window,
+            args.admission_max_rps or "unbounded",
+        )
+
     server_id = args.server_id or f"{args.host}:{args.port}"
     server = CapacityServer(
         server_id,
@@ -179,6 +212,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         solver_dtype=args.solver_dtype,
         persist=persist,
         mesh=mesh,
+        admission=admission,
     )
 
     port = await server.start(

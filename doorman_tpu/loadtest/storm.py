@@ -1,0 +1,204 @@
+"""RPC storm driver: closed-loop GetCapacity hammering for overload
+testing.
+
+Unlike the recipe-driven workers (doorman_tpu.loadtest.worker — polite
+clients that honor refresh intervals), a storm worker fires its next
+refresh the moment the previous one returns: the adversarial load shape
+the admission front-end (doorman_tpu.admission) exists to survive. Each
+worker is pinned to a priority band so per-band goodput under shedding
+is observable; shed responses (RESOURCE_EXHAUSTED) are honored by
+default with the same jittered retry-after pacing the real client uses
+— pass ``honor_retry_after=False`` to model misbehaving clients that
+hammer through the hint.
+
+Used by bench.py's ``server_rpc_storm`` against an in-process server,
+and standalone against a real deployment:
+
+    python -m doorman_tpu.loadtest.storm --server localhost:15000 \
+        --resource storm --workers 64 --duration 10 --bands 0,1,2
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import random
+import time
+from typing import Dict, List, Optional
+
+import grpc
+
+from doorman_tpu.admission.policy import RETRY_AFTER_KEY
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto.grpc_api import CapacityStub
+from doorman_tpu.utils import flagenv
+
+log = logging.getLogger("doorman.loadtest.storm")
+
+__all__ = ["run_storm", "percentile"]
+
+
+def percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (0 when empty)."""
+    if not sorted_values:
+        return 0.0
+    idx = min(
+        len(sorted_values) - 1,
+        max(0, int(round(q * (len(sorted_values) - 1)))),
+    )
+    return sorted_values[idx]
+
+
+def _retry_after(error: grpc.aio.AioRpcError) -> Optional[float]:
+    try:
+        for key, value in error.trailing_metadata() or ():
+            if key == RETRY_AFTER_KEY:
+                return float(value)
+    except Exception:
+        pass
+    return None
+
+
+async def _worker(
+    index: int,
+    addr: str,
+    resource: str,
+    band: int,
+    wants: float,
+    deadline: float,
+    stats: Dict,
+    rng: random.Random,
+    honor_retry_after: bool,
+    rpc_timeout: Optional[float],
+) -> None:
+    async with grpc.aio.insecure_channel(addr) as channel:
+        stub = CapacityStub(channel)
+        request = pb.GetCapacityRequest(client_id=f"storm-{index}")
+        rr = request.resource.add()
+        rr.resource_id = resource
+        rr.wants = wants
+        rr.priority = band
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            try:
+                out = await stub.GetCapacity(request, timeout=rpc_timeout)
+                if out.HasField("mastership"):
+                    stats["redirects"] += 1
+                    continue
+                stats["ok"] += 1
+                stats["ok_by_band"][band] = (
+                    stats["ok_by_band"].get(band, 0) + 1
+                )
+                stats["latencies"].append(time.monotonic() - t0)
+                # Carry the grant forward like a refreshing client.
+                rr.has.CopyFrom(out.response[0].gets)
+            except grpc.aio.AioRpcError as e:
+                if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    stats["shed"] += 1
+                    stats["shed_by_band"][band] = (
+                        stats["shed_by_band"].get(band, 0) + 1
+                    )
+                    if honor_retry_after:
+                        hint = _retry_after(e) or 1.0
+                        # Half jitter, like the real client: at least
+                        # hint/2, spread over the other half.
+                        await asyncio.sleep(
+                            min(
+                                0.5 * hint + rng.uniform(0, 0.5 * hint),
+                                max(deadline - time.monotonic(), 0.0),
+                            )
+                        )
+                else:
+                    stats["errors"] += 1
+            except Exception:
+                stats["errors"] += 1
+
+
+async def run_storm(
+    addr: str,
+    resource: str = "storm",
+    *,
+    workers: int = 32,
+    duration: float = 5.0,
+    bands: tuple = (0,),
+    wants: float = 10.0,
+    honor_retry_after: bool = True,
+    rpc_timeout: Optional[float] = None,
+    seed: int = 0,
+) -> Dict:
+    """Drive `workers` closed-loop GetCapacity clients (round-robin
+    over `bands`) for `duration` seconds; returns aggregate stats with
+    per-band goodput and latency percentiles (seconds)."""
+    stats: Dict = {
+        "ok": 0, "shed": 0, "errors": 0, "redirects": 0,
+        "ok_by_band": {}, "shed_by_band": {}, "latencies": [],
+    }
+    rng = random.Random(seed)
+    deadline = time.monotonic() + duration
+    start = time.monotonic()
+    await asyncio.gather(*(
+        _worker(
+            i, addr, resource, bands[i % len(bands)], wants, deadline,
+            stats, random.Random(rng.random()), honor_retry_after,
+            rpc_timeout,
+        )
+        for i in range(workers)
+    ))
+    elapsed = max(time.monotonic() - start, 1e-9)
+    lat = sorted(stats.pop("latencies"))
+    return {
+        **stats,
+        "workers": workers,
+        "duration_s": round(elapsed, 3),
+        "goodput_qps": round(stats["ok"] / elapsed, 1),
+        "offered_qps": round(
+            (stats["ok"] + stats["shed"] + stats["errors"]) / elapsed, 1
+        ),
+        "p50_s": round(percentile(lat, 0.50), 6),
+        "p99_s": round(percentile(lat, 0.99), 6),
+    }
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="loadtest-storm")
+    p.add_argument("--server", default="localhost:15000",
+                   help="doorman server address")
+    p.add_argument("--resource", default="storm")
+    p.add_argument("--workers", type=int, default=64)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--bands", default="0",
+                   help="comma-separated priority bands, workers "
+                        "round-robin over them (e.g. '0,1,2')")
+    p.add_argument("--wants", type=float, default=10.0)
+    p.add_argument("--ignore-retry-after", action="store_true",
+                   help="hammer through shed responses (misbehaving-"
+                        "client mode)")
+    p.add_argument("--rpc-timeout", type=float, default=0.0,
+                   help="per-RPC gRPC deadline in seconds (0: none); "
+                        "short deadlines exercise the admission "
+                        "fast-fail path")
+    return p
+
+
+def main(argv=None) -> None:
+    parser = make_parser()
+    flagenv.populate(parser)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    out = asyncio.run(run_storm(
+        args.server, args.resource,
+        workers=args.workers,
+        duration=args.duration,
+        bands=tuple(int(b) for b in args.bands.split(",") if b.strip()),
+        wants=args.wants,
+        honor_retry_after=not args.ignore_retry_after,
+        rpc_timeout=args.rpc_timeout or None,
+    ))
+    import json
+
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

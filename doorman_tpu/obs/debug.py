@@ -36,6 +36,9 @@ ROUTES = (
                       "tick phase totals"),
     ("/debug/resources", "per-lease tables (?resource=<id> for one)"),
     ("/debug/requests", "recent RPC samples (?limit=N)"),
+    ("/debug/admission", "admission control: AIMD level, per-band "
+                         "admit probabilities, shed tallies, coalescing "
+                         "windows (?format=json)"),
     ("/debug/traces", "span tracer summary; ?format=chrome downloads a "
                       "Perfetto-loadable trace"),
     ("/debug/vars", "expvar-style JSON snapshot"),
@@ -275,6 +278,67 @@ class DebugServer:
             title="/debug/requests", body="".join(sections)
         )
 
+    def _admission_statuses(self) -> Dict[str, Optional[dict]]:
+        """server id -> admission status dict (None when the server has
+        no admission front-end), snapshotted on each owning loop."""
+        out: Dict[str, Optional[dict]] = {}
+        for server, loop in self._servers:
+            adm = getattr(server, "_admission", None)
+            out[server.id] = (
+                self._call(loop, adm.status) if adm is not None else None
+            )
+        return out
+
+    def _admission_page(self) -> str:
+        sections = []
+        for sid, st in self._admission_statuses().items():
+            if st is None:
+                sections.append(
+                    f"<h2>server {html.escape(sid)}</h2>"
+                    "<p>admission control disabled</p>"
+                )
+                continue
+            ctl = st.get("controller") or {}
+            bands = ctl.get("bands", {})
+            band_rows = "".join(
+                f"<tr><td>{html.escape(b)}</td><td>{p:g}</td></tr>"
+                for b, p in sorted(
+                    bands.items(), key=lambda kv: -int(kv[0])
+                )
+            )
+            tally_rows = "".join(
+                f"<tr><td>{html.escape(key)}</td>"
+                f"<td>{v['admitted']}</td><td>{v['shed']}</td>"
+                f"<td>{v['fast_fail']}</td></tr>"
+                for key, v in sorted(st.get("tallies", {}).items())
+            )
+            co = st.get("coalescer") or {}
+            sections.append(
+                f"<h2>server {html.escape(sid)}</h2>"
+                f"<p>level: {ctl.get('level', 1.0):g} | "
+                f"pressure: {ctl.get('pressure', 0.0):g} | "
+                f"offered rps: "
+                f"{ctl.get('offered_rps_last_window', 0.0):g} | "
+                f"windows: {ctl.get('windows', 0)} "
+                f"(overloaded: {ctl.get('overloaded_windows', 0)})</p>"
+                f"<p>latency ewma: {ctl.get('latency_ewma_s', 0.0):g}s | "
+                f"queue ewma: {ctl.get('queue_ewma', 0.0):g} | "
+                f"tick lag ewma: {ctl.get('tick_lag_ewma', 0.0):g}</p>"
+                f"<p>coalescing: window {co.get('window_s', 0.0):g}s, "
+                f"{co.get('flushes', 0)} flushes, "
+                f"{co.get('coalesced_requests', 0)} coalesced requests, "
+                f"max occupancy {co.get('max_occupancy', 0)}</p>"
+                "<table><tr><th>band</th><th>admit probability</th></tr>"
+                f"{band_rows}</table>"
+                "<table><tr><th>method/band</th><th>admitted</th>"
+                f"<th>shed</th><th>fast-fail</th></tr>{tally_rows}</table>"
+            )
+        if not sections:
+            sections.append("<p>no servers</p>")
+        return _PAGE.format(
+            title="/debug/admission", body="".join(sections)
+        )
+
     def _resources_page(self, only: Optional[str]) -> str:
         sections = []
         for (server, loop), st in zip(self._servers, self._statuses()):
@@ -355,6 +419,21 @@ class DebugServer:
                             debug._resources_page(only),
                             "text/html",
                         )
+                    elif url.path == "/debug/admission":
+                        q = parse_qs(url.query)
+                        if q.get("format", [""])[0] == "json":
+                            body, ctype = (
+                                json.dumps(
+                                    debug._admission_statuses(),
+                                    indent=2, default=str,
+                                ),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = (
+                                debug._admission_page(),
+                                "text/html",
+                            )
                     elif url.path == "/debug/requests":
                         q = parse_qs(url.query)
                         try:

@@ -27,6 +27,7 @@ from typing import Awaitable, Callable, Dict, List, Optional
 
 import grpc
 
+from doorman_tpu.admission.policy import RETRY_AFTER_KEY
 from doorman_tpu.algorithms import Request
 from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource, algo_kind_for
@@ -101,6 +102,7 @@ class CapacityServer(CapacityServicer):
         solver_dtype: str = "f64",
         persist=None,  # Optional[doorman_tpu.persist.PersistManager]
         mesh=None,  # Optional[jax.sharding.Mesh] for the resident tick
+        admission=None,  # Optional[doorman_tpu.admission.Admission]
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -185,6 +187,14 @@ class CapacityServer(CapacityServicer):
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.port: Optional[int] = None
+
+        # RPC admission control (doorman_tpu.admission): micro-batched
+        # GetCapacity decisions, AIMD overload shedding by priority
+        # band, deadline fast-fail. None serves every request inline —
+        # the reference's only defense is client refresh cadence.
+        self._admission = (
+            admission.bind(self) if admission is not None else None
+        )
 
         # Metrics hooks; the metrics module replaces these when enabled.
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
@@ -580,6 +590,7 @@ class CapacityServer(CapacityServicer):
         driven directly by tests and operational tooling, and a manual
         tick racing the loop's must queue, not corrupt."""
         async with self._tick_lock:
+            tick_start = self._clock()
             with trace_mod.default_tracer().span(
                 "server.tick", cat="tick",
                 args={"server": self.id,
@@ -590,6 +601,15 @@ class CapacityServer(CapacityServicer):
                 # beat: flush this tick's journal deltas and take the
                 # cadenced snapshot inside the tick span.
                 self.persist_step()
+            if self._admission is not None:
+                # Tick lag feeds the overload controller: a solve
+                # falling behind its cadence is overload even while
+                # the RPC path still looks healthy. Measured on the
+                # server clock so chaos replays stay deterministic.
+                self._admission.controller.observe_tick_lag(
+                    (self._clock() - tick_start)
+                    / max(self.tick_interval, 1e-9)
+                )
 
     async def _tick_once_locked(self) -> None:
         if not self.resources:
@@ -778,6 +798,8 @@ class CapacityServer(CapacityServicer):
         start = self._clock()
         out = pb.GetCapacityResponse()
         err = False
+        adm = self._admission
+        adm_observed = False
         with self._rpc_span("GetCapacity", context, request.client_id):
             try:
                 if not self.is_master:
@@ -786,7 +808,34 @@ class CapacityServer(CapacityServicer):
                 msg = config_mod.validate_get_capacity_request(request)
                 if msg is not None:
                     err = True
+                    if adm is not None:
+                        adm.observe_rpc(self._clock() - start)
+                        adm_observed = True
                     await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+                if adm is not None:
+                    shed = adm.check_get_capacity(request, context)
+                    if shed is not None:
+                        err = True
+                        # Latency is observed BEFORE the abort: the
+                        # abort's unwind races the client's resumption
+                        # on the loop, so a finally-side measurement
+                        # can land after the chaos clock's next tick
+                        # advance and feed the controller a bogus
+                        # tick-length latency sample.
+                        adm.observe_rpc(self._clock() - start)
+                        adm_observed = True
+                        # The retry-after hint rides trailing metadata
+                        # (a non-OK status cannot carry a response
+                        # message); semantically it is the admission
+                        # path's refresh_interval — "come back in N
+                        # seconds" (doc/admission.md).
+                        context.set_trailing_metadata((
+                            (RETRY_AFTER_KEY, f"{shed.retry_after:.3f}"),
+                        ))
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED, shed.reason
+                        )
+                    return await adm.serve_get_capacity(request)
                 for req in request.resource:
                     has = req.has.capacity if req.HasField("has") else 0.0
                     lease, res = self._decide(
@@ -802,12 +851,17 @@ class CapacityServer(CapacityServicer):
                     resp.safe_capacity = res.safe_capacity()
                 return out
             finally:
-                self.on_request("GetCapacity", self._clock() - start, err)
+                dur = self._clock() - start
+                if adm is not None and not adm_observed:
+                    # Latency feed for the overload controller (shed
+                    # requests observed at the abort above).
+                    adm.observe_rpc(dur)
+                self.on_request("GetCapacity", dur, err)
                 self.request_log.record(
                     "GetCapacity", request.client_id,
                     [r.resource_id for r in request.resource],
                     sum(r.wants for r in request.resource),
-                    self._clock() - start, err,
+                    dur, err,
                 )
 
     async def GetServerCapacity(self, request, context):
@@ -828,6 +882,18 @@ class CapacityServer(CapacityServicer):
             if msg is not None:
                 err = True
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+            if self._admission is not None:
+                # Never shed (one RPC carries a whole downstream
+                # subtree's demand — the shed matrix's 'never' row);
+                # tallied so the load is visible in the counters.
+                self._admission.note_pass_through(
+                    "GetServerCapacity",
+                    max(
+                        (band.priority for r in request.resource
+                         for band in r.wants),
+                        default=0,
+                    ),
+                )
             self._sweep_server_bands()
             for req in request.resource:
                 # One sub-lease per priority band: the store keeps the
@@ -920,6 +986,10 @@ class CapacityServer(CapacityServicer):
             if msg is not None:
                 err = True
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, msg)
+            if self._admission is not None:
+                # Never shed: releases shrink load; refusing one pins
+                # a dead client's capacity and worsens the overload.
+                self._admission.note_pass_through("ReleaseCapacity")
             for resource_id in request.resource_id:
                 res = self.resources.get(resource_id)
                 if res is None:
@@ -1170,6 +1240,11 @@ class CapacityServer(CapacityServicer):
             "persist": (
                 self._persist.status()
                 if self._persist is not None
+                else None
+            ),
+            "admission": (
+                self._admission.status()
+                if self._admission is not None
                 else None
             ),
             "last_restore": self.last_restore,

@@ -1,0 +1,527 @@
+"""Admission front-end: coalescing parity, AIMD shedding, the
+retry-after contract, deadline fast-fail, and the debug surfaces.
+
+The load-bearing test is the coalescing parity pin: the same request
+stream through the per-request handler path and through the coalesced
+grouped pass must yield byte-identical responses AND stores (Python and
+native engines, mixed priority bands, `has`-carrying refreshes) — the
+micro-batching front-end is an optimization, never a semantic change.
+"""
+
+import asyncio
+import random
+
+import grpc
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.admission import Admission, RETRY_AFTER_KEY
+from doorman_tpu.admission.controller import AimdController
+from doorman_tpu.admission.deadline import DecisionLatency, fast_fail_reason
+from doorman_tpu.admission.policy import SHED_MATRIX, sheddable
+from doorman_tpu.client import Client
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto.grpc_api import CapacityStub
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+from doorman_tpu.utils.backoff import backoff
+
+CONFIG = """
+resources:
+- identifier_glob: prop
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60,
+              refresh_interval: 1, learning_mode_duration: 0}
+- identifier_glob: "*"
+  capacity: 120
+  safe_capacity: 3
+  algorithm: {kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_server(admission=None, clock=None, **kwargs):
+    server = CapacityServer(
+        "adm-test", TrivialElection(), mode="immediate",
+        minimum_refresh_interval=0.0, admission=admission,
+        **({"clock": clock} if clock is not None else {}), **kwargs,
+    )
+    port = await server.start(0, host="127.0.0.1")
+    await server.load_config(parse_yaml_config(CONFIG))
+    await asyncio.sleep(0)
+    server.current_master = f"127.0.0.1:{port}"
+    return server, f"127.0.0.1:{port}"
+
+
+# ----------------------------------------------------------------------
+# Controller
+# ----------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_aimd_level_collapses_under_overload_and_recovers():
+    clock = FakeClock()
+    ctl = AimdController(
+        window=1.0, clock=clock, rng=random.Random(0), max_rps=10.0
+    )
+    # Calm traffic: level stays at 1, everything admitted.
+    for tick in range(3):
+        clock.t = float(tick)
+        for _ in range(3):
+            admitted, _ = ctl.admit(0)
+            assert admitted
+    assert ctl.level == 1.0
+    # Storm: 30 arrivals/window for 4 windows; multiplicative decrease
+    # every boundary.
+    levels = []
+    for tick in range(3, 7):
+        clock.t = float(tick)
+        for _ in range(30):
+            ctl.admit(0)
+        levels.append(ctl.level)
+    assert levels[-1] < levels[0] <= 1.0
+    assert ctl.overloaded_windows >= 3
+    # Recovery: additive increase back to 1 once the storm stops.
+    for tick in range(7, 25):
+        clock.t = float(tick)
+        ctl.admit(0)
+    assert ctl.level == 1.0
+
+
+def test_hard_cap_sheds_inside_the_spiking_window():
+    ctl = AimdController(
+        window=1.0, clock=FakeClock(), rng=random.Random(0), max_rps=5.0
+    )
+    outcomes = [ctl.admit(0)[0] for _ in range(12)]
+    # The first window's budget (5) is admitted, the spike past it is
+    # shed before any AIMD boundary — single-band, so no floor applies.
+    assert outcomes[:5] == [True] * 5
+    assert not any(outcomes[5:])
+
+
+def test_bands_shed_bottom_up_and_top_band_never():
+    clock = FakeClock()
+    ctl = AimdController(
+        window=1.0, clock=clock, rng=random.Random(1), max_rps=1000.0
+    )
+    for band in (0, 1, 2):
+        ctl.admit(band)
+    for level, expect_full, expect_zero in (
+        (1.0, {0, 1, 2}, set()),
+        (0.8, {1, 2}, set()),        # band 0 partially shed
+        (2 / 3, {1, 2}, {0}),        # band 0 extinguished exactly here
+        (0.5, {2}, {0}),             # band 1 partially shed
+        (1 / 3, {2}, {0, 1}),        # band 1 extinguished
+        (0.2, set(), {0, 1}),        # top band probability dips too —
+                                     # but admit() floors it (below)
+    ):
+        ctl.level = level
+        for band in (0, 1, 2):
+            p = ctl.band_probability(band)
+            if band in expect_zero:
+                assert p == 0.0, (level, band, p)
+            if band in expect_full:
+                assert p == pytest.approx(1.0), (level, band, p)
+        # Band probabilities are monotone in the band.
+        assert (
+            ctl.band_probability(0)
+            <= ctl.band_probability(1)
+            <= ctl.band_probability(2)
+        )
+    # The top band is admitted even at the floor level (lower bands
+    # exist to shed first) — and the probability mapping never sheds it
+    # anyway while level >= 1/B.
+    ctl.level = ctl.min_level
+    admitted, _ = ctl.admit(2)
+    assert admitted
+
+
+def test_retry_after_bounded_and_longer_for_deeper_bands():
+    ctl = AimdController(window=1.0, clock=FakeClock(), rng=random.Random(2))
+    for band in (0, 1, 2):
+        ctl.admit(band)
+    ctl.level = 0.1
+    low, mid, top = (ctl.retry_after(b) for b in (0, 1, 2))
+    assert low > mid > top >= ctl.window
+    assert low <= ctl.max_retry_after
+
+
+def test_backoff_full_jitter_opt_in():
+    # Deterministic ladder unchanged by default.
+    assert backoff(1.0, 60.0, 3) == pytest.approx(1.3**3)
+    rng = random.Random(42)
+    draws = [backoff(1.0, 60.0, 8, jitter=rng) for _ in range(64)]
+    ladder = backoff(1.0, 60.0, 8)
+    assert all(0.0 <= d <= ladder for d in draws)
+    # Actually jittered (not the ladder value), and seeded-reproducible.
+    assert len(set(round(d, 9) for d in draws)) > 32
+    assert draws == [
+        backoff(1.0, 60.0, 8, jitter=random.Random(42)) for _ in range(64)
+    ][:64] or draws[0] == backoff(1.0, 60.0, 8, jitter=random.Random(42))
+
+
+def test_shed_matrix():
+    assert sheddable("GetCapacity")
+    for method in ("ReleaseCapacity", "GetServerCapacity", "Discovery"):
+        assert not sheddable(method)
+    assert set(SHED_MATRIX) == {
+        "GetCapacity", "GetServerCapacity", "ReleaseCapacity", "Discovery"
+    }
+
+
+def test_deadline_fast_fail_math():
+    lat = DecisionLatency()
+    lat.observe(0.02)
+
+    class Ctx:
+        def __init__(self, remaining):
+            self._r = remaining
+
+        def time_remaining(self):
+            return self._r
+
+    assert fast_fail_reason(None, 0.1, lat) is None
+    assert fast_fail_reason(Ctx(None), 0.1, lat) is None
+    assert fast_fail_reason(Ctx(10.0), 0.1, lat) is None
+    reason = fast_fail_reason(Ctx(0.05), 0.1, lat)
+    assert reason is not None and "fast-fail" in reason
+
+
+# ----------------------------------------------------------------------
+# Coalescing parity
+# ----------------------------------------------------------------------
+
+
+def _round_requests(round_index, prev=None):
+    """A mixed stream: six clients over three bands, two resources,
+    some requests carrying both resources; round 2 carries `has` from
+    round 1's responses (a refreshing population)."""
+    reqs = []
+    for i in range(6):
+        cid = f"cl{i}"
+        req = pb.GetCapacityRequest(client_id=cid)
+        rids = ["prop"] if i % 3 == 0 else ["fair"]
+        if i % 2 == 0:
+            rids = rids + (["fair"] if rids == ["prop"] else ["prop"])
+        for rid in rids:
+            rr = req.resource.add()
+            rr.resource_id = rid
+            rr.wants = 10.0 * (i + 1) + round_index
+            rr.priority = i % 3
+            if prev is not None:
+                for resp in prev[cid].response:
+                    if resp.resource_id == rid:
+                        rr.has.CopyFrom(resp.gets)
+        reqs.append(req)
+    return reqs
+
+
+async def _drive_per_request(server, reqs):
+    out = {}
+    for req in reqs:
+        out[req.client_id] = await server.GetCapacity(req, None)
+    return out
+
+
+async def _drive_coalesced(server, reqs):
+    # Tasks created in submission order park in one window (the test
+    # window is far longer than task startup), so arrival order is the
+    # per-request stream's order.
+    tasks = [
+        asyncio.create_task(server.GetCapacity(req, None)) for req in reqs
+    ]
+    outs = await asyncio.gather(*tasks)
+    return {req.client_id: out for req, out in zip(reqs, outs)}
+
+
+def _store_rows(server):
+    return {
+        rid: sorted(res.store.dump_rows())
+        for rid, res in server.resources.items()
+    }
+
+
+def _native_available():
+    from doorman_tpu import native
+
+    return native.native_available()
+
+
+@pytest.mark.parametrize("native_store", [False, True],
+                         ids=["python-store", "native-store"])
+def test_coalescing_parity(native_store):
+    if native_store and not _native_available():
+        pytest.skip("native store engine unavailable")
+
+    async def body():
+        clock = FakeClock(1_000.0)
+        ref, _ = await make_server(clock=clock, native_store=native_store)
+        adm = Admission(coalesce_window=0.05)
+        coal, _ = await make_server(
+            admission=adm, clock=clock, native_store=native_store
+        )
+        try:
+            prev_ref = await _drive_per_request(
+                ref, _round_requests(0)
+            )
+            prev_coal = await _drive_coalesced(coal, _round_requests(0))
+            # Round 2: refreshes carrying each path's own round-1
+            # grants (identical if round 1 was), on a later clock.
+            clock.t += 5.0
+            out_ref = await _drive_per_request(
+                ref, _round_requests(1, prev_ref)
+            )
+            out_coal = await _drive_coalesced(
+                coal, _round_requests(1, prev_coal)
+            )
+            for rnd_ref, rnd_coal in (
+                (prev_ref, prev_coal), (out_ref, out_coal),
+            ):
+                assert {
+                    cid: r.SerializeToString()
+                    for cid, r in rnd_ref.items()
+                } == {
+                    cid: r.SerializeToString()
+                    for cid, r in rnd_coal.items()
+                }
+            assert _store_rows(ref) == _store_rows(coal)
+            # The windows really coalesced (not 12 one-request flushes).
+            assert adm.coalescer.max_occupancy == 6
+            assert adm.coalescer.coalesced_requests == 12
+        finally:
+            await ref.stop()
+            await coal.stop()
+
+    run(body())
+
+
+def test_coalesced_mastership_flip_redirects_parked_requests():
+    async def body():
+        adm = Admission(coalesce_window=0.05)
+        server, _ = await make_server(admission=adm)
+        try:
+            req = _round_requests(0)[0]
+            task = asyncio.create_task(server.GetCapacity(req, None))
+            await asyncio.sleep(0)  # task parks in the window
+            server.is_master = False
+            out = await task
+            assert out.HasField("mastership")
+            assert not out.response
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+# ----------------------------------------------------------------------
+# Shedding over real gRPC + the retry-after contract
+# ----------------------------------------------------------------------
+
+
+def _request(client_id, rid="fair", wants=5.0, priority=0):
+    req = pb.GetCapacityRequest(client_id=client_id)
+    rr = req.resource.add()
+    rr.resource_id = rid
+    rr.wants = wants
+    rr.priority = priority
+    return req
+
+
+def test_shed_carries_retry_after_and_never_sheds_releases():
+    async def body():
+        # window 100s + max_rps tiny: after 2 admits everything sheds
+        # for the rest of the test (deterministic, no level math).
+        adm = Admission(
+            coalesce_window=0.0, max_rps=0.02, window=100.0,
+            rng=random.Random(0),
+        )
+        server, addr = await make_server(admission=adm)
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                hints = []
+                ok = 0
+                for i in range(8):
+                    try:
+                        await stub.GetCapacity(_request(f"s{i}"))
+                        ok += 1
+                    except grpc.aio.AioRpcError as e:
+                        assert (
+                            e.code()
+                            == grpc.StatusCode.RESOURCE_EXHAUSTED
+                        )
+                        hints += [
+                            float(v)
+                            for k, v in e.trailing_metadata() or ()
+                            if k == RETRY_AFTER_KEY
+                        ]
+                assert ok == 2 and len(hints) == 6
+                assert all(h > 0 for h in hints)
+                # The never-shed rows of the matrix stay served under
+                # the same overload.
+                out = await stub.ReleaseCapacity(
+                    pb.ReleaseCapacityRequest(
+                        client_id="s0", resource_id=["fair"]
+                    )
+                )
+                assert not out.HasField("mastership")
+                gsc = pb.GetServerCapacityRequest(server_id="downstream")
+                rr = gsc.resource.add()
+                rr.resource_id = "fair"
+                band = rr.wants.add()
+                band.priority = 1
+                band.num_clients = 2
+                band.wants = 8.0
+                out = await stub.GetServerCapacity(gsc)
+                assert len(out.response) == 1
+            tallies = server._admission.tallies
+            assert tallies[("GetCapacity", 0)]["shed"] == 6
+            assert tallies[("ReleaseCapacity", 0)]["shed"] == 0
+            assert tallies[("GetServerCapacity", 1)]["shed"] == 0
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_client_honors_retry_after_with_jitter_and_keeps_lease():
+    async def body():
+        adm = Admission(
+            coalesce_window=0.0, max_rps=0.01, window=100.0,
+            rng=random.Random(0),
+        )
+        server, addr = await make_server(admission=adm)
+        client = Client(
+            addr, "jit", minimum_refresh_interval=0.0, max_retries=0
+        )
+        try:
+            await client.resource("fair", 5.0)
+            interval, retry = await client._perform_requests(0)
+            assert retry == 0  # first refresh admitted (budget 1)
+            res = client.resources["fair"]
+            granted = res.current_capacity()
+            assert granted == 5.0
+            # Every further refresh sheds; the interval obeys the
+            # server hint (half jitter: in [hint/2, hint]) and the
+            # lease — and the believed capacity — are retained.
+            hint = server._admission.controller.retry_after(0)
+            for _ in range(3):
+                interval, retry = await client._perform_requests(0)
+                assert retry == 1
+                assert 0.5 * hint <= interval <= hint
+                assert res.lease is not None
+                assert res.current_capacity() == granted
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_deadline_fast_fail_over_grpc():
+    async def body():
+        # A long coalescing window: any RPC deadline shorter than it
+        # must fast-fail instead of parking.
+        adm = Admission(coalesce_window=0.5)
+        server, addr = await make_server(admission=adm)
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await stub.GetCapacity(
+                        _request("dl", priority=2), timeout=0.1
+                    )
+                assert (
+                    excinfo.value.code()
+                    == grpc.StatusCode.RESOURCE_EXHAUSTED
+                )
+            tallies = server._admission.tallies
+            assert tallies[("GetCapacity", 2)]["fast_fail"] == 1
+            # A deadline fast-fail is the request's own fault, never an
+            # overload shed — the top-band goodput floor is untouched.
+            assert tallies[("GetCapacity", 2)]["shed"] == 0
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+# ----------------------------------------------------------------------
+# Observability
+# ----------------------------------------------------------------------
+
+
+def test_debug_admission_page_and_status():
+    import json
+    import urllib.request
+
+    from doorman_tpu.obs import DebugServer
+
+    async def body():
+        adm = Admission(coalesce_window=0.0)
+        server, addr = await make_server(admission=adm)
+        async with grpc.aio.insecure_channel(addr) as ch:
+            stub = CapacityStub(ch)
+            await stub.GetCapacity(_request("dbg", priority=1))
+        st = server.status()["admission"]
+        assert st["controller"]["level"] == 1.0
+        assert st["tallies"]["GetCapacity/1"]["admitted"] == 1
+        await server.stop()
+        return server
+
+    server = run(body())
+    debug = DebugServer(port=0)
+    debug.add_server(server, None)
+    debug.start()
+    try:
+        html_page = urllib.request.urlopen(
+            f"http://127.0.0.1:{debug.port}/debug/admission", timeout=5
+        ).read().decode()
+        assert "level" in html_page and "GetCapacity/1" in html_page
+        js = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{debug.port}/debug/admission?format=json",
+            timeout=5,
+        ).read().decode())
+        assert js["adm-test"]["tallies"]["GetCapacity/1"]["admitted"] == 1
+        index = urllib.request.urlopen(
+            f"http://127.0.0.1:{debug.port}/debug", timeout=5
+        ).read().decode()
+        assert "/debug/admission" in index
+    finally:
+        debug.stop()
+
+
+def test_admission_metrics_in_default_registry():
+    from doorman_tpu.obs import default_registry
+
+    async def body():
+        adm = Admission(coalesce_window=0.0)
+        server, addr = await make_server(admission=adm)
+        try:
+            async with grpc.aio.insecure_channel(addr) as ch:
+                stub = CapacityStub(ch)
+                await stub.GetCapacity(_request("met", priority=3))
+        finally:
+            await server.stop()
+
+    run(body())
+    text = default_registry().expose()
+    assert "doorman_admission_requests" in text
+    assert (
+        'doorman_admission_requests{method="GetCapacity",band="3",'
+        'outcome="admitted"}' in text
+    )
+    assert "doorman_admission_window_occupancy" in text

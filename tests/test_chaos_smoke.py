@@ -82,6 +82,38 @@ def test_master_flap_warm_restores_instead_of_relearning(verdicts):
     assert warm[0] < v["heal_tick"]
 
 
+def test_client_storm_sheds_bottom_up_with_top_band_floor(verdicts):
+    v = verdicts["client_storm"]
+    plan = get_plan("client_storm")
+    storm_tick = plan.events[0].at_tick
+    tallies = v["admission"]["s0"]
+    # The goodput floor: the top band is NEVER shed; the swarm's band
+    # eats nearly all of the shedding; the middle band sees some (the
+    # level collapse walks up from the bottom) but keeps its leases.
+    assert tallies["GetCapacity/2"]["shed"] == 0
+    assert tallies["GetCapacity/0"]["shed"] > tallies["GetCapacity/1"]["shed"] > 0
+    # The shed matrix is law: every release is admitted — including
+    # the swarm's own 20 releases when it drains at heal.
+    assert tallies["ReleaseCapacity/0"]["shed"] == 0
+    assert tallies["ReleaseCapacity/0"]["admitted"] >= 20
+    storm = [e for e in v["event_log"] if e[1] == "storm"]
+    assert len(storm) == plan.events[0].duration_ticks
+    # The hard per-window cap bites in the storm's FIRST window — some
+    # of the swarm is admitted under the budget (no blanket denial),
+    # the rest sheds before the AIMD level ever moved...
+    assert 0 < storm[0][2] < plan.events[0].params["clients"]
+    # ...and once the level collapses the swarm is fully shed.
+    assert storm[-1][2] == 0
+    adm = [e for e in v["event_log"] if e[1] == "admission"]
+    # Nothing shed before the storm, and the post-heal additive
+    # recovery readmits every band before the run ends.
+    assert all(e[4] == 0 for e in adm if e[0] < storm_tick)
+    assert all(e[4] == 0 for e in adm[-3:])
+    # The baseline clients ride through byte-unchanged: shed refreshes
+    # retain leases, so convergence is immediate at heal.
+    assert v["converged_after_heal_ticks"] == 0
+
+
 def test_etcd_brownout_survives_single_hiccup_then_relearns(verdicts):
     v = verdicts["etcd_brownout"]
     plan = get_plan("etcd_brownout")
