@@ -39,6 +39,8 @@ from doorman_tpu.chaos.plan import FaultPlan
 from doorman_tpu.client.client import Client
 from doorman_tpu.client.connection import Connection
 from doorman_tpu.obs import metrics as metrics_mod
+from doorman_tpu.obs import slo as slo_mod
+from doorman_tpu.obs.flightrec import FlightRecorder, store_digest
 from doorman_tpu.server.config import parse_yaml_config
 from doorman_tpu.server.election import Election, InMemoryKV, TrivialElection
 from doorman_tpu.server.server import CapacityServer
@@ -158,6 +160,18 @@ class ChaosRunner:
         self._logged_restores: set = set()
         self.log: List[list] = []
         self.violations: List[Violation] = []
+        # The run's black box: one record per VIRTUAL tick, built only
+        # from deterministic fields (virtual time, masters, admission
+        # tallies, store digests) so a violation dump is byte-stable
+        # across replays of the same seeded plan. Dumped on the FIRST
+        # violation (the trigger that needs explaining); the dump lands
+        # in the verdict as `flightrec_dump`.
+        self.flightrec = FlightRecorder(
+            capacity=plan.total_ticks + 8,
+            component=f"chaos:{plan.name}",
+            clock=self.clock,
+        )
+        self.flight_dump: Optional[dict] = None
         # Fault / violation tallies in the default registry, so a chaos
         # run's damage shows on the same /metrics surface as everything
         # else (and soaks can assert on them).
@@ -407,6 +421,103 @@ class ChaosRunner:
                     round(adm.controller.level, 6),
                 ])
 
+    def _flight_record(self, tick: int, masters: tuple,
+                       violations: List[Violation]) -> None:
+        """One deterministic black-box record per virtual tick, and the
+        violation-triggered dump (first violation only: that is the
+        failure the dump exists to explain; later ones are in the ring
+        of the same dump or the event log)."""
+        rec: dict = {
+            "t": self.clock(),
+            "tick": tick,
+            "masters": list(masters),
+            "digests": {
+                name: store_digest(server.resources)
+                for name, server in sorted(self.servers.items())
+            },
+        }
+        admission = {}
+        persist_seq = {}
+        for name, server in sorted(self.servers.items()):
+            adm = getattr(server, "_admission", None)
+            if adm is not None:
+                admitted = 0
+                shed_by_band: Dict[str, int] = {}
+                for (method, band), counts in adm.tallies.items():
+                    if method != "GetCapacity":
+                        continue
+                    admitted += counts["admitted"]
+                    if counts["shed"]:
+                        shed_by_band[str(band)] = counts["shed"]
+                admission[name] = {
+                    "level": round(adm.controller.level, 6),
+                    "admitted": admitted,
+                    "shed_by_band": shed_by_band,
+                }
+            if server._persist is not None:
+                persist_seq[name] = server._persist.journal.seq
+        if admission:
+            rec["admission"] = admission
+        if persist_seq:
+            rec["persist_seq"] = persist_seq
+        if violations:
+            rec["violations"] = [v.as_log() for v in violations]
+        self.flightrec.record(**rec)
+        if violations and self.flight_dump is None:
+            self.flight_dump = self.flightrec.dump(
+                f"invariant:{violations[0].invariant}"
+            )
+
+    def _slo_block(self, converged_at: Optional[int],
+                   heal_tick: int) -> dict:
+        """Machine-readable SLO verdicts for the run: reconvergence
+        ticks vs the plan's budget, and — on admission-enabled plans —
+        the top-band goodput floor with the per-band tallies embedded.
+        Deltas vs prior rounds come from the trajectory comparator
+        (None until a prior BENCH round embedded the same verdict)."""
+        plan = self.plan
+        specs = [slo_mod.reconvergence_spec(
+            plan.reconverge_ticks, name=f"{plan.name}:reconverge_ticks"
+        )]
+        band_tallies: Dict[int, Dict[str, int]] = {}
+        for server in self.servers.values():
+            adm = getattr(server, "_admission", None)
+            if adm is None:
+                continue
+            for (method, band), counts in adm.tallies.items():
+                if method != "GetCapacity":
+                    continue
+                entry = band_tallies.setdefault(
+                    int(band), {"admitted": 0, "shed": 0, "fast_fail": 0}
+                )
+                for key in entry:
+                    entry[key] += counts.get(key, 0)
+        if band_tallies:
+            specs.append(slo_mod.top_band_goodput_spec(
+                name=f"{plan.name}:top_band_goodput"
+            ))
+        scalars = {}
+        if converged_at is not None:
+            scalars["reconverge_ticks"] = float(converged_at - heal_tick)
+        verdicts = slo_mod.SloEngine(specs).evaluate(
+            slo_mod.SloInputs(scalars=scalars, band_tallies=band_tallies)
+        )
+        for v in verdicts:
+            if (
+                v["slo"].endswith(":reconverge_ticks")
+                and v["status"] == "no_data"
+            ):
+                # Never reconverged is a hard fail, not missing data.
+                v["status"] = "fail"
+                v["detail"] = {"note": "no reconvergence within the run"}
+        comparator = slo_mod.TrajectoryComparator()
+        for v in verdicts:
+            v["delta_vs_prev"] = comparator.slo_delta(v)
+        return {
+            "ok": all(v["status"] != "fail" for v in verdicts),
+            "verdicts": verdicts,
+        }
+
     def _snapshot(self) -> Dict[str, float]:
         return {
             f"{cl.id}/{rid}": res.current_capacity()
@@ -483,14 +594,15 @@ class ChaosRunner:
                 for server in self.servers.values():
                     server.persist_step()
 
-                for v in checker.check_tick(
+                tick_violations = checker.check_tick(
                     tick, self.servers, groups,
                     # Active storm clients are checked too: an admitted
                     # storm lease is subject to lag-never-lead like any
                     # other (baseline/convergence snapshots stay on the
                     # base population only).
                     self.clients + self.storm_clients,
-                ):
+                )
+                for v in tick_violations:
                     self._record_violation(v)
                     self.log.append([tick] + v.as_log())
 
@@ -515,6 +627,7 @@ class ChaosRunner:
                         [tick, "converged", tick - heal_tick]
                     )
 
+                self._flight_record(tick, masters, tick_violations)
                 self.clock.advance(plan.tick_interval)
         finally:
             await self._teardown()
@@ -530,6 +643,11 @@ class ChaosRunner:
             ))
             self.log.append(
                 [plan.total_ticks] + self.violations[-1].as_log()
+            )
+            # The end-of-run violation is a dump trigger like any other
+            # (servers are stopped but their stores are still readable).
+            self._flight_record(
+                plan.total_ticks, last_masters, [self.violations[-1]]
             )
         log_bytes = json.dumps(
             self.log, sort_keys=True, separators=(",", ":")
@@ -561,6 +679,14 @@ class ChaosRunner:
             ),
             "violations": [v.as_log() for v in self.violations],
             "admission": admission_tallies,
+            # Machine-readable SLO verdicts (reconvergence budget,
+            # top-band goodput floor with per-band tallies), each with
+            # its delta vs the last round that embedded the same verdict.
+            "slo": self._slo_block(converged_at, heal_tick),
+            # The black box: on any invariant violation the per-tick
+            # ring is dumped here (None on a clean run) — its records
+            # replay the last N ticks leading into the failure.
+            "flightrec_dump": self.flight_dump,
             "event_log": self.log,
             "log_sha256": hashlib.sha256(log_bytes).hexdigest(),
         }

@@ -43,6 +43,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", default="",
                    help="write the run's virtual-time event log as a "
                         "Chrome trace (open in Perfetto) to this path")
+    p.add_argument("--flightrec", default="",
+                   help="write the run's flight-recorder dump (the "
+                        "violation-triggered dump when one fired, else "
+                        "an on-demand dump of the full ring) to this "
+                        "path, plus a Chrome-trace overlay beside it")
     return p
 
 
@@ -67,12 +72,28 @@ async def run(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     plan = load_plan(args.plan)
-    verdict = await ChaosRunner(plan).run()
+    runner = ChaosRunner(plan)
+    verdict = await runner.run()
     text = json.dumps(verdict, indent=1)
     print(text)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.flightrec:
+        dump = verdict.get("flightrec_dump") or runner.flightrec.view(
+            "on_demand"
+        )
+        with open(args.flightrec, "w") as f:
+            json.dump(dump, f, indent=1, sort_keys=True)
+            f.write("\n")
+        overlay_path = args.flightrec + ".trace.json"
+        with open(overlay_path, "w") as f:
+            f.write(runner.flightrec.chrome_overlay(dump["records"]))
+        print(
+            f"wrote flight-recorder dump to {args.flightrec} "
+            f"(overlay: {overlay_path})",
+            file=sys.stderr,
+        )
     if args.trace:
         from doorman_tpu.chaos.trace_export import write_chrome_trace
 

@@ -77,6 +77,18 @@ def make_parser() -> argparse.ArgumentParser:
                         "/debug/traces (?format=chrome for Perfetto)")
     p.add_argument("--trace-buffer", type=int, default=65536,
                    help="span ring-buffer capacity (with --trace)")
+    p.add_argument("--flightrec-buffer", type=int, default=512,
+                   help="per-tick flight-recorder ring capacity: each "
+                        "tick records phase laps, admission level and "
+                        "shed tallies, persist seq, mastership epoch "
+                        "and a store digest; auto-dumped on an "
+                        "unhandled tick exception and served at "
+                        "/debug/flightrec (0 disables)")
+    p.add_argument("--flightrec-dir", default="",
+                   help="directory for flight-recorder auto-dumps "
+                        "(JSON + Chrome-trace overlay per dump); "
+                        "defaults to $DOORMAN_FLIGHTREC_DIR, empty "
+                        "keeps dumps in-memory only")
     p.add_argument("--persist", default="",
                    help="durable lease-state snapshots + journal for "
                         "warm master takeover: 'file:<dir>' (shared "
@@ -213,6 +225,8 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         persist=persist,
         mesh=mesh,
         admission=admission,
+        flightrec_capacity=args.flightrec_buffer,
+        flightrec_dir=args.flightrec_dir or None,
     )
 
     port = await server.start(

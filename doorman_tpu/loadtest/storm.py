@@ -90,7 +90,11 @@ async def _worker(
                 stats["ok_by_band"][band] = (
                     stats["ok_by_band"].get(band, 0) + 1
                 )
-                stats["latencies"].append(time.monotonic() - t0)
+                latency = time.monotonic() - t0
+                stats["latencies"].append(latency)
+                stats["latencies_by_band"].setdefault(band, []).append(
+                    latency
+                )
                 # Carry the grant forward like a refreshing client.
                 rr.has.CopyFrom(out.response[0].gets)
             except grpc.aio.AioRpcError as e:
@@ -133,6 +137,7 @@ async def run_storm(
     stats: Dict = {
         "ok": 0, "shed": 0, "errors": 0, "redirects": 0,
         "ok_by_band": {}, "shed_by_band": {}, "latencies": [],
+        "latencies_by_band": {},
     }
     rng = random.Random(seed)
     deadline = time.monotonic() + duration
@@ -147,6 +152,10 @@ async def run_storm(
     ))
     elapsed = max(time.monotonic() - start, 1e-9)
     lat = sorted(stats.pop("latencies"))
+    lat_by_band = {
+        band: sorted(values)
+        for band, values in stats.pop("latencies_by_band").items()
+    }
     return {
         **stats,
         "workers": workers,
@@ -157,6 +166,17 @@ async def run_storm(
         ),
         "p50_s": round(percentile(lat, 0.50), 6),
         "p99_s": round(percentile(lat, 0.99), 6),
+        # Per-band tails: the admission SLOs (obs.slo.storm_slo_verdicts)
+        # hold each band's admission-on p99 against the admission-off
+        # tail for the same band.
+        "p50_s_by_band": {
+            band: round(percentile(v, 0.50), 6)
+            for band, v in sorted(lat_by_band.items())
+        },
+        "p99_s_by_band": {
+            band: round(percentile(v, 0.99), 6)
+            for band, v in sorted(lat_by_band.items())
+        },
     }
 
 

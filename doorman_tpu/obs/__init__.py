@@ -1,7 +1,8 @@
 """Observability: metrics registry (Prometheus text exposition), the
-debug HTTP server with /debug/status, /debug/resources, /debug/traces
-and /metrics, and the zero-dependency span tracer (obs.trace) with
-Chrome trace-event export.
+debug HTTP server with /debug/status, /debug/resources, /debug/traces,
+/debug/slo, /debug/flightrec and /metrics, the zero-dependency span
+tracer (obs.trace) with Chrome trace-event export, the declarative SLO
+engine (obs.slo) and the per-tick flight recorder (obs.flightrec).
 
 Capability parity with the reference's go/status/status.go (composable
 status parts), go/cmd/doorman/resourcez.go (per-lease table), and the
@@ -17,17 +18,32 @@ from doorman_tpu.obs.metrics import (
     instrument_server,
 )
 from doorman_tpu.obs.debug import DebugServer, add_status_part
+from doorman_tpu.obs.flightrec import FlightRecorder, store_digest
+from doorman_tpu.obs.slo import (
+    SloEngine,
+    SloInputs,
+    SloSpec,
+    TrajectoryComparator,
+    server_slos,
+)
 from doorman_tpu.obs.trace import Tracer, default_tracer
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
+    "SloEngine",
+    "SloInputs",
+    "SloSpec",
     "Tracer",
+    "TrajectoryComparator",
     "default_registry",
     "default_tracer",
     "instrument_server",
+    "server_slos",
+    "store_digest",
     "DebugServer",
     "add_status_part",
 ]

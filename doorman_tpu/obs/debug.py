@@ -41,6 +41,12 @@ ROUTES = (
                          "windows (?format=json)"),
     ("/debug/traces", "span tracer summary; ?format=chrome downloads a "
                       "Perfetto-loadable trace"),
+    ("/debug/slo", "SLO verdicts per server (tick budget, RPC p99, "
+                   "top-band goodput floor, restore staleness); "
+                   "?format=json"),
+    ("/debug/flightrec", "per-tick flight recorder: ring summary; "
+                         "?format=json dumps the last N tick records, "
+                         "?format=chrome the overlay trace"),
     ("/debug/vars", "expvar-style JSON snapshot"),
     ("/metrics", "Prometheus text exposition"),
     ("/healthz", "liveness probe"),
@@ -164,6 +170,7 @@ class DebugServer:
                 f"ticks: {st.get('ticks', 0)} "
                 f"(idle: {st.get('idle_ticks', 0)}) | "
                 f"last tick: {st.get('last_tick_ms', 0):g} ms</p>"
+                + self._status_obs_line(st)
                 + (
                     "<p>tick phases (total ms): "
                     + html.escape(
@@ -191,10 +198,49 @@ class DebugServer:
             "<a href='/debug/resources'>resources</a> | "
             "<a href='/debug/requests'>requests</a> | "
             "<a href='/debug/traces'>traces</a> | "
+            "<a href='/debug/slo'>slo</a> | "
+            "<a href='/debug/flightrec'>flightrec</a> | "
             "<a href='/metrics'>metrics</a> | "
             "<a href='/debug/vars'>vars</a></p>"
         )
         return _PAGE.format(title="/debug/status", body=body)
+
+    @staticmethod
+    def _status_obs_line(st: dict) -> str:
+        """Flight-recorder head/occupancy and the last SLO verdict on
+        the status overview (the satellite surface: one glance says
+        whether the black box is rolling and whether the SLOs hold)."""
+        parts = []
+        fr = st.get("flightrec")
+        if fr:
+            last = fr.get("last_dump")
+            parts.append(
+                f"flight recorder: head seq {fr.get('head_seq', 0)}, "
+                f"ring {fr.get('occupancy', 0)}/{fr.get('capacity', 0)}"
+                + (
+                    f", last dump {html.escape(str(last.get('reason')))}"
+                    if last
+                    else ""
+                )
+                + " (<a href='/debug/flightrec'>flightrec</a>)"
+            )
+        slo = st.get("slo")
+        if slo:
+            failed = [
+                v["slo"]
+                for v in slo.get("verdicts", [])
+                if v.get("status") == "fail"
+            ]
+            parts.append(
+                "last SLO verdict: "
+                + (
+                    "pass"
+                    if slo.get("ok")
+                    else "FAIL (" + html.escape(", ".join(failed)) + ")"
+                )
+                + " (<a href='/debug/slo'>slo</a>)"
+            )
+        return f"<p>{' | '.join(parts)}</p>" if parts else ""
 
     def _index_page(self) -> str:
         rows = "".join(
@@ -339,6 +385,123 @@ class DebugServer:
             title="/debug/admission", body="".join(sections)
         )
 
+    def _slo_statuses(self) -> Dict[str, Optional[dict]]:
+        """server id -> last_slo dict (a fresh evaluation per request;
+        None when the server has no SLO support), each snapshotted on
+        its owning loop."""
+        out: Dict[str, Optional[dict]] = {}
+        for server, loop in self._servers:
+            if not hasattr(server, "evaluate_slos"):
+                out[getattr(server, "id", "?")] = None
+                continue
+
+            def evaluate(server=server):
+                server.evaluate_slos(registry=self.registry)
+                return server.last_slo
+
+            out[server.id] = self._call(loop, evaluate)
+        return out
+
+    def _slo_page(self) -> str:
+        sections = []
+        for sid, st in self._slo_statuses().items():
+            if st is None:
+                sections.append(
+                    f"<h2>server {html.escape(sid)}</h2>"
+                    "<p>no SLO support</p>"
+                )
+                continue
+            rows = ""
+            for v in st.get("verdicts", []):
+                observed = v.get("observed")
+                obs_txt = "-" if observed is None else f"{observed:g}"
+                rows += (
+                    f"<tr><td>{html.escape(v['slo'])}</td>"
+                    f"<td>{html.escape(v['status'])}</td>"
+                    f"<td>{obs_txt}</td>"
+                    f"<td>{v['kind']} {v['target']:g}</td>"
+                    f"<td>{html.escape(v.get('unit', ''))}</td>"
+                    f"<td>{html.escape(v.get('description', ''))}</td>"
+                    "</tr>"
+                )
+            ok = st.get("ok")
+            cls = "master" if ok else "notmaster"
+            sections.append(
+                f"<h2>server {html.escape(sid)}</h2>"
+                f"<p class={cls!r}>overall: "
+                f"{'pass' if ok else 'FAIL'}</p>"
+                "<table><tr><th>slo</th><th>status</th><th>observed</th>"
+                f"<th>target</th><th>unit</th><th>what</th></tr>{rows}"
+                "</table>"
+            )
+        if not sections:
+            sections.append("<p>no servers</p>")
+        return _PAGE.format(title="/debug/slo", body="".join(sections))
+
+    def _flightrec_views(self) -> Dict[str, Optional[dict]]:
+        """server id -> on-demand flight-recorder view (no side
+        effects), snapshotted on each owning loop."""
+        out: Dict[str, Optional[dict]] = {}
+        for server, loop in self._servers:
+            fr = getattr(server, "flightrec", None)
+            out[getattr(server, "id", "?")] = (
+                self._call(loop, fr.view) if fr is not None else None
+            )
+        return out
+
+    def _flightrec_chrome(self) -> str:
+        """Overlay trace of the first server with a recorder."""
+        for server, loop in self._servers:
+            fr = getattr(server, "flightrec", None)
+            if fr is not None:
+                records = self._call(loop, fr.snapshot)
+                return fr.chrome_overlay(records)
+        return json.dumps({"traceEvents": []})
+
+    def _flightrec_page(self) -> str:
+        sections = []
+        for server, loop in self._servers:
+            fr = getattr(server, "flightrec", None)
+            sid = getattr(server, "id", "?")
+            if fr is None:
+                sections.append(
+                    f"<h2>server {html.escape(sid)}</h2>"
+                    "<p>flight recorder disabled</p>"
+                )
+                continue
+            st = fr.status()
+            last = st.get("last_dump")
+            last_txt = (
+                f"{last['reason']} at head seq {last['head_seq']} "
+                f"({last['records']} records)"
+                if last
+                else "(none)"
+            )
+            recent = self._call(loop, fr.snapshot)[-5:]
+            recent_rows = "".join(
+                f"<tr><td>{r.get('seq')}</td><td>{r.get('tick', '-')}</td>"
+                f"<td>{r.get('wall_ms', '-')}</td>"
+                f"<td>{html.escape(str(r.get('digest', '-')))}</td>"
+                f"<td>{html.escape(str(r.get('error', '')))}</td></tr>"
+                for r in recent
+            )
+            sections.append(
+                f"<h2>server {html.escape(sid)}</h2>"
+                f"<p>head seq: {st['head_seq']} | occupancy: "
+                f"{st['occupancy']}/{st['capacity']} | last dump: "
+                f"{html.escape(last_txt)}</p>"
+                "<table><tr><th>seq</th><th>tick</th><th>wall ms</th>"
+                f"<th>digest</th><th>error</th></tr>{recent_rows}</table>"
+                "<p><a href='/debug/flightrec?format=json'>dump JSON</a>"
+                " | <a href='/debug/flightrec?format=chrome'>overlay "
+                "trace</a></p>"
+            )
+        if not sections:
+            sections.append("<p>no servers</p>")
+        return _PAGE.format(
+            title="/debug/flightrec", body="".join(sections)
+        )
+
     def _resources_page(self, only: Optional[str]) -> str:
         sections = []
         for (server, loop), st in zip(self._servers, self._statuses()):
@@ -432,6 +595,39 @@ class DebugServer:
                         else:
                             body, ctype = (
                                 debug._admission_page(),
+                                "text/html",
+                            )
+                    elif url.path == "/debug/slo":
+                        q = parse_qs(url.query)
+                        if q.get("format", [""])[0] == "json":
+                            body, ctype = (
+                                json.dumps(
+                                    debug._slo_statuses(),
+                                    indent=2, default=str,
+                                ),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = debug._slo_page(), "text/html"
+                    elif url.path == "/debug/flightrec":
+                        q = parse_qs(url.query)
+                        fmt = q.get("format", [""])[0]
+                        if fmt == "json":
+                            body, ctype = (
+                                json.dumps(
+                                    debug._flightrec_views(),
+                                    indent=1, default=str,
+                                ),
+                                "application/json",
+                            )
+                        elif fmt == "chrome":
+                            body, ctype = (
+                                debug._flightrec_chrome(),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = (
+                                debug._flightrec_page(),
                                 "text/html",
                             )
                     elif url.path == "/debug/requests":

@@ -30,7 +30,18 @@ from typing import Dict, Optional, Tuple
 from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import trace as trace_mod
 
-__all__ = ["PHASE_BUCKETS", "PhaseRecorder"]
+__all__ = ["PHASE_BUCKETS", "PhaseRecorder", "last_shard_bytes"]
+
+# (component, direction) -> per-shard payload bytes of the most recent
+# mesh-sharded tick. The gauges carry the same numbers for /metrics;
+# this plain snapshot lets the flight recorder embed them in its
+# per-tick records without reparsing the registry.
+_last_shard_bytes: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+
+
+def last_shard_bytes() -> Dict[Tuple[str, str], Tuple[int, ...]]:
+    """Most recent per-shard payload bytes, keyed (component, direction)."""
+    return dict(_last_shard_bytes)
 
 PHASE_BUCKETS = (
     1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
@@ -119,6 +130,7 @@ class PhaseRecorder:
         per = [int(b) for b in per_shard]
         if not per:
             return
+        _last_shard_bytes[(self._component, direction)] = tuple(per)
         per_g, skew_g = _shard_metrics()
         for d, b in enumerate(per):
             per_g.set(b, self._component, direction, str(d))

@@ -103,6 +103,8 @@ class CapacityServer(CapacityServicer):
         persist=None,  # Optional[doorman_tpu.persist.PersistManager]
         mesh=None,  # Optional[jax.sharding.Mesh] for the resident tick
         admission=None,  # Optional[doorman_tpu.admission.Admission]
+        flightrec_capacity: int = 512,
+        flightrec_dir: Optional[str] = None,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -139,6 +141,10 @@ class CapacityServer(CapacityServicer):
         self._last_band_sweep = 0.0
         self.is_master = False
         self.became_master_at: float = 0.0
+        # Counts every mastership transition (either direction); the
+        # flight recorder stamps it on each tick record so a dump reader
+        # can see exactly which ticks straddle a flip.
+        self.mastership_epoch = 0
         # Durable lease-state snapshots + journal (doorman_tpu.persist);
         # None keeps the reference's wipe-and-relearn behavior. The
         # request path journals every decide/release, the tick pipeline
@@ -195,6 +201,26 @@ class CapacityServer(CapacityServicer):
         self._admission = (
             admission.bind(self) if admission is not None else None
         )
+
+        # Per-tick flight recorder (doorman_tpu.obs.flightrec): one
+        # structured record per tick_once, auto-dumped on an unhandled
+        # tick exception; /debug/flightrec serves the ring on demand.
+        # flightrec_capacity=0 disables.
+        if flightrec_capacity > 0:
+            from doorman_tpu.obs.flightrec import FlightRecorder
+
+            self.flightrec: Optional[FlightRecorder] = FlightRecorder(
+                flightrec_capacity,
+                component=f"server:{server_id}",
+                clock=clock,
+                dump_dir=flightrec_dir,
+            )
+        else:
+            self.flightrec = None
+        self._flight_phase_prev: Dict[str, float] = {}
+        # Last SLO evaluation (evaluate_slos); status() and /debug/slo
+        # read it. None until the first evaluation.
+        self.last_slo: Optional[dict] = None
 
         # Metrics hooks; the metrics module replaces these when enabled.
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
@@ -340,6 +366,7 @@ class CapacityServer(CapacityServicer):
         shortened per-resource (doorman_tpu.persist.restore)."""
         was_master = self.is_master
         self.is_master = is_master
+        self.mastership_epoch += 1
         # Election transitions land on the trace timeline and in the
         # default registry — a mastership flip explains every gap or
         # learning-mode plateau around it.
@@ -591,16 +618,25 @@ class CapacityServer(CapacityServicer):
         tick racing the loop's must queue, not corrupt."""
         async with self._tick_lock:
             tick_start = self._clock()
-            with trace_mod.default_tracer().span(
-                "server.tick", cat="tick",
-                args={"server": self.id,
-                      "resources": len(self.resources)},
-            ):
-                await self._tick_once_locked()
-                # The tick pipeline is the batch server's durability
-                # beat: flush this tick's journal deltas and take the
-                # cadenced snapshot inside the tick span.
-                self.persist_step()
+            try:
+                with trace_mod.default_tracer().span(
+                    "server.tick", cat="tick",
+                    args={"server": self.id,
+                          "resources": len(self.resources)},
+                ):
+                    await self._tick_once_locked()
+                    # The tick pipeline is the batch server's durability
+                    # beat: flush this tick's journal deltas and take the
+                    # cadenced snapshot inside the tick span.
+                    self.persist_step()
+            except Exception as exc:
+                # The black box's trigger: an unhandled tick exception
+                # dumps the last N ticks before the error propagates
+                # (to _tick_loop's log, or the chaos runner's
+                # tick_error entry).
+                self._flight_abort(tick_start, exc)
+                raise
+            self._flight_record_tick(tick_start)
             if self._admission is not None:
                 # Tick lag feeds the overload controller: a solve
                 # falling behind its cadence is overload even while
@@ -743,6 +779,128 @@ class CapacityServer(CapacityServicer):
             self._persist.step(self)
         except Exception:
             log.exception("%s: persistence step failed", self.id)
+
+    # ------------------------------------------------------------------
+    # Flight recorder + SLO evaluation
+    # ------------------------------------------------------------------
+
+    def _flight_record_tick(self, tick_start: float) -> None:
+        """One structured record per applied tick: wall time, per-phase
+        lap deltas, admission level + per-band shed tallies, per-shard
+        transfer bytes, persist journal seq, mastership epoch, and a
+        store digest. O(#resources) — the stores keep running sums."""
+        fr = self.flightrec
+        if fr is None:
+            return
+        from doorman_tpu.obs import phases as phases_mod
+        from doorman_tpu.obs.flightrec import store_digest
+
+        now = self._clock()
+        totals = self._phase_totals()
+        phases = {
+            k: round((v - self._flight_phase_prev.get(k, 0.0)) * 1000.0, 3)
+            for k, v in totals.items()
+            if v - self._flight_phase_prev.get(k, 0.0) > 0
+        }
+        self._flight_phase_prev = totals
+        rec = {
+            "t": now,
+            "tick": self._ticks_done,
+            "wall_ms": round((now - tick_start) * 1000.0, 3),
+            "is_master": self.is_master,
+            "epoch": self.mastership_epoch,
+            "resources": len(self.resources),
+            "digest": store_digest(self.resources),
+        }
+        if phases:
+            rec["phases"] = phases
+        if self._admission is not None:
+            admitted = 0
+            shed_by_band: Dict[str, int] = {}
+            for (method, band), counts in self._admission.tallies.items():
+                if method != "GetCapacity":
+                    continue
+                admitted += counts["admitted"]
+                if counts["shed"]:
+                    shed_by_band[str(band)] = counts["shed"]
+            rec["admission_level"] = round(
+                self._admission.controller.level, 6
+            )
+            rec["admitted_total"] = admitted
+            if shed_by_band:
+                rec["shed_by_band"] = shed_by_band
+        if self._persist is not None:
+            rec["persist_seq"] = self._persist.journal.seq
+        shards = phases_mod.last_shard_bytes()
+        if shards:
+            rec["shard_bytes"] = {
+                f"{c}/{d}": list(v) for (c, d), v in sorted(shards.items())
+            }
+        fr.record(**rec)
+
+    def _flight_abort(self, tick_start: float, exc: BaseException) -> None:
+        """Record the failed tick and auto-dump the ring. Must never
+        raise: the black box cannot be allowed to mask the exception it
+        is documenting."""
+        fr = self.flightrec
+        if fr is None:
+            return
+        try:
+            now = self._clock()
+            fr.record(
+                t=now,
+                tick=self._ticks_done,
+                wall_ms=round((now - tick_start) * 1000.0, 3),
+                is_master=self.is_master,
+                epoch=self.mastership_epoch,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            fr.dump("tick_exception")
+        except Exception:
+            log.exception("%s: flight-recorder dump failed", self.id)
+
+    def evaluate_slos(self, registry=None) -> List[dict]:
+        """Evaluate the standing SLO set (obs.slo.server_slos) over the
+        flight-recorder window, the request histograms in `registry`
+        (default: the process-global registry), the admission tallies,
+        and the last restore summary. Caches the result in `last_slo`
+        for status() and /debug/slo."""
+        from doorman_tpu.obs import slo as slo_mod
+
+        samples: Dict[str, list] = {}
+        if self.flightrec is not None:
+            ticks = [
+                r["wall_ms"]
+                for r in self.flightrec.snapshot()
+                if isinstance(r.get("wall_ms"), (int, float))
+            ]
+            if ticks:
+                samples["tick_ms"] = ticks
+        scalars: Dict[str, float] = {}
+        if self.last_restore is not None and self.last_restore.get(
+            "mode"
+        ) == "warm":
+            scalars["restore_staleness_s"] = float(
+                self.last_restore.get("age", 0.0)
+            )
+        band_tallies: Dict[int, dict] = {}
+        if self._admission is not None:
+            for (method, band), counts in self._admission.tallies.items():
+                if method == "GetCapacity":
+                    band_tallies[int(band)] = dict(counts)
+        inputs = slo_mod.SloInputs(
+            registry=registry or metrics_mod.default_registry(),
+            samples=samples,
+            scalars=scalars,
+            band_tallies=band_tallies,
+        )
+        verdicts = slo_mod.SloEngine(slo_mod.server_slos()).evaluate(inputs)
+        self.last_slo = {
+            "at": self._clock(),
+            "ok": all(v["status"] != "fail" for v in verdicts),
+            "verdicts": verdicts,
+        }
+        return verdicts
 
     async def _persist_loop(self) -> None:
         interval = self._persist.flush_interval
@@ -1248,6 +1406,12 @@ class CapacityServer(CapacityServicer):
                 else None
             ),
             "last_restore": self.last_restore,
+            "flightrec": (
+                self.flightrec.status()
+                if self.flightrec is not None
+                else None
+            ),
+            "slo": self.last_slo,
             "resources": {
                 rid: res.status() for rid, res in self.resources.items()
             },

@@ -99,3 +99,41 @@ def wait_for_backend(
             elapsed = time.monotonic() - attempt_start
             time.sleep(max(0.0, per_timeout_s - elapsed))
     return reason
+
+
+def probe_devices(
+    per_timeout_s: float = 120.0,
+    cwd: Optional[str] = None,
+    probe_argv=None,
+) -> Optional[tuple]:
+    """One throwaway-subprocess probe of the default backend's device
+    inventory: (platform, device_count), or None when the probe fails
+    (unreachable or broken backend). Same isolation rationale as
+    wait_for_backend — jax is never initialized in-process, so the
+    caller can still pick a different platform (e.g. a forced
+    multi-device CPU fallback) before its own first backend use."""
+    import subprocess
+    import sys
+
+    argv = probe_argv or [
+        sys.executable, "-c",
+        "import jax; d = jax.devices(); "
+        "print('ok', d[0].platform, len(d))",
+    ]
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True,
+            timeout=per_timeout_s, cwd=cwd,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "ok":
+            try:
+                return parts[1], int(parts[2])
+            except ValueError:
+                return None
+    return None

@@ -145,3 +145,43 @@ def test_intermediate_partition_degrades_then_heals(verdicts):
     assert "degraded" in kinds and "converged" in kinds
     degraded_tick = next(e[0] for e in v["event_log"] if e[1] == "degraded")
     assert degraded_tick < v["heal_tick"]
+
+
+@pytest.mark.parametrize("name", sorted(PLANS))
+def test_verdicts_carry_slo_and_flightrec(verdicts, name):
+    """Every plan's verdict is an SLO surface and a black box: a
+    reconvergence verdict always, per-band tallies on admission plans,
+    and no flight-recorder dump on a clean run (violations are what
+    trigger the dump — tests/test_flightrec.py forces one)."""
+    v = verdicts[name]
+    slo_v = {x["slo"]: x for x in v["slo"]["verdicts"]}
+    recon = slo_v[f"{name}:reconverge_ticks"]
+    assert recon["status"] == "pass"
+    assert recon["observed"] == v["converged_after_heal_ticks"]
+    assert recon["target"] == get_plan(name).reconverge_ticks
+    # The deltas field is always present (None until a prior round
+    # embedded the same verdict) — the trajectory contract.
+    assert all("delta_vs_prev" in x for x in v["slo"]["verdicts"])
+    assert v["slo"]["ok"]
+    assert v["flightrec_dump"] is None
+
+
+def test_client_storm_slo_embeds_per_band_tallies(verdicts):
+    """The acceptance surface: chaos client_storm emits a machine-
+    readable top-band goodput verdict whose detail carries the exact
+    per-band admitted/shed tallies."""
+    v = verdicts["client_storm"]
+    slo_v = {x["slo"]: x for x in v["slo"]["verdicts"]}
+    floor = slo_v["client_storm:top_band_goodput"]
+    assert floor["status"] == "pass" and floor["observed"] == 1.0
+    per_band = floor["detail"]["per_band"]
+    # Bottom band shed hardest, the top band never (mirrors the
+    # top_band_floor invariant, now with a numeric trajectory).
+    assert per_band["0"]["shed"] > 0
+    assert per_band[str(floor["detail"]["band"])]["shed"] == 0
+    # The verdict tallies agree with the runner's admission block.
+    adm = v["admission"]["s0"]
+    for band, counts in per_band.items():
+        key = f"GetCapacity/{band}"
+        assert adm[key]["admitted"] == counts["admitted"]
+        assert adm[key]["shed"] == counts["shed"]
