@@ -408,6 +408,17 @@ def bench_server_tick() -> None:
     then per-tick wall times are measured; median reported (best
     alongside). The first tick (rotate=1: every grant delivered) is
     spot-checked against the numpy oracles before any timing.
+
+    Measured twice from identical initial state: once with
+    admission-fused staging (each churn batch plays an admission
+    window — the window that wrote the rows pre-packs them into the
+    solver's staging cache, engine.FusedStaging — emitted as its own
+    `..._fused_wall_ms` row with its own tick-budget SLO verdict), then
+    the round-trip store->drain->pack path as the headline row (the
+    driver parses the LAST line; keeping the headline's semantics
+    unchanged keeps its delta_vs_prev honest across rounds). Both rides
+    the engine seam's compact transfers (bf16-exact wants, int32
+    indices); tests/test_engine.py pins the two paths byte-identical.
     """
     import jax
 
@@ -424,143 +435,216 @@ def bench_server_tick() -> None:
         dtype = np.float32
 
     R, C = NUM_RESOURCES, CLIENTS_PER_RESOURCE
-    rng = np.random.default_rng(11)
-    engine = native.StoreEngine()
-    kind_choices = np.array(
-        [
-            pb.Algorithm.NO_ALGORITHM,
-            pb.Algorithm.STATIC,
-            pb.Algorithm.PROPORTIONAL_SHARE,
-            pb.Algorithm.FAIR_SHARE,
-        ],
-        dtype=np.int64,
-    )
-    kinds = rng.choice(kind_choices, size=R, p=[0.05, 0.05, 0.65, 0.25])
-    capacity = rng.integers(100, 100_000, R).astype(np.float64)
 
-    resources = []
-    rids = np.empty(R * C, np.int32)
-    for r in range(R):
-        tpl = pb.ResourceTemplate(
-            identifier_glob=f"res{r}",
-            capacity=float(capacity[r]),
-            algorithm=pb.Algorithm(
-                kind=int(kinds[r]), lease_length=600, refresh_interval=16
-            ),
+    def run(fused: bool) -> dict:
+        """One full build + warmup + measured window; a fresh engine
+        and rng per variant, so both paths start from byte-identical
+        stores and replay the same churn stream."""
+        rng = np.random.default_rng(11)
+        engine = native.StoreEngine()
+        kind_choices = np.array(
+            [
+                pb.Algorithm.NO_ALGORITHM,
+                pb.Algorithm.STATIC,
+                pb.Algorithm.PROPORTIONAL_SHARE,
+                pb.Algorithm.FAIR_SHARE,
+            ],
+            dtype=np.int64,
         )
-        res = Resource(f"res{r}", tpl, store_factory=engine.store)
-        resources.append(res)
-        rids[r * C : (r + 1) * C] = res.store._rid
-
-    # 1M distinct clients, C per resource, loaded in one bulk call.
-    cids = np.array(
-        [engine.client_handle(f"c{i}") for i in range(R * C)], np.int64
-    )
-    wants = rng.integers(0, 100, R * C).astype(np.float64)
-    now = time.time()
-    engine.bulk_assign(
-        rids,
-        cids,
-        np.full(R * C, now + 600.0),
-        np.full(R * C, 16.0),
-        np.zeros(R * C),
-        wants,
-        np.ones(R * C, np.int32),
-    )
-
-    solver = ResidentDenseSolver(
-        engine, dtype=dtype, device=device,
-        rotate_ticks=1,  # first tick delivers everything (oracle check)
-    )
-    solver.step(resources)  # build + compile + full delivery
-
-    # Spot-check the first tick against the numpy oracles: after it,
-    # has == grants computed from (capacity, wants, has=0).
-    from doorman_tpu.algorithms.tick import oracle_row
-    from doorman_tpu.core.resource import static_param
-
-    for r in rng.integers(0, R, 10):
-        res = resources[r]
-        st = [res.store.get(f"c{i}") for i in range(r * C, (r + 1) * C)]
-        w = np.array([lease.wants for lease in st])
-        g = np.array([lease.has for lease in st])
-        k = int(kinds[r])
-        expected = oracle_row(
-            k, float(capacity[r]), static_param(res.template),
-            w, np.zeros_like(w), np.ones_like(w),
+        kinds = rng.choice(
+            kind_choices, size=R, p=[0.05, 0.05, 0.65, 0.25]
         )
-        np.testing.assert_allclose(
-            g, expected, rtol=2e-6, atol=1e-4, err_msg=f"res{r} kind {k}"
+        capacity = rng.integers(100, 100_000, R).astype(np.float64)
+
+        resources = []
+        rids = np.empty(R * C, np.int32)
+        for r in range(R):
+            tpl = pb.ResourceTemplate(
+                identifier_glob=f"res{r}",
+                capacity=float(capacity[r]),
+                algorithm=pb.Algorithm(
+                    kind=int(kinds[r]), lease_length=600,
+                    refresh_interval=16,
+                ),
+            )
+            res = Resource(f"res{r}", tpl, store_factory=engine.store)
+            resources.append(res)
+            rids[r * C : (r + 1) * C] = res.store._rid
+        res_rids = rids[::C].copy()  # one engine rid per resource
+
+        # 1M distinct clients, C per resource, loaded in one bulk call.
+        cids = np.array(
+            [engine.client_handle(f"c{i}") for i in range(R * C)],
+            np.int64,
+        )
+        wants = rng.integers(0, 100, R * C).astype(np.float64)
+        now = time.time()
+        engine.bulk_assign(
+            rids,
+            cids,
+            np.full(R * C, now + 600.0),
+            np.full(R * C, 16.0),
+            np.zeros(R * C),
+            wants,
+            np.ones(R * C, np.int32),
         )
 
-    # Steady state: grants rotate out on the refresh cadence
-    # (refresh_interval=16s at ~1s ticks), dirty rows deliver same-tick.
-    solver.rotate_ticks = SERVER_ROTATE_TICKS
-
-    # Pre-generate per-tick demand churn (5% of resources change wants),
-    # applied through the engine's bulk path as the RPC handlers' store
-    # writes land between ticks.
-    n_ticks = SERVER_WARMUP + TICKS_SERVER
-    churn_rows = [
-        rng.choice(R, CHURN_RESOURCES, replace=False)
-        for _ in range(n_ticks)
-    ]
-    churn_wants = [
-        rng.integers(0, 100, CHURN_RESOURCES * C).astype(np.float64)
-        for _ in range(n_ticks)
-    ]
-
-    def churn(t):
-        # A client refresh's store effect: wants update + expiry stamp,
-        # has preserved (grants are the only thing that changes has).
-        sel = churn_rows[t]
-        edge = (sel[:, None] * C + np.arange(C)).ravel()
-        engine.bulk_refresh(
-            rids[edge], cids[edge],
-            np.full(len(edge), time.time() + 600.0),
-            np.full(len(edge), 16.0),
-            churn_wants[t],
+        solver = ResidentDenseSolver(
+            engine, dtype=dtype, device=device,
+            rotate_ticks=1,  # first tick delivers all (oracle check)
         )
+        if fused:
+            solver.attach_staging()
+        solver.step(resources)  # build + compile + full delivery
 
-    tick_ms = []
-    churn_ms = []
-    handles = []
-    phase_mark = {}
-    collects_mark = 0
-    phase_samples = [dict(solver.phase_s)]
-    for t in range(n_ticks):
-        if t == SERVER_WARMUP:
-            phase_mark = dict(solver.phase_s)
-            collects_mark = solver.ticks
+        # Spot-check the first tick against the numpy oracles: after
+        # it, has == grants computed from (capacity, wants, has=0).
+        from doorman_tpu.algorithms.tick import oracle_row
+        from doorman_tpu.core.resource import static_param
+
+        for r in rng.integers(0, R, 10):
+            res = resources[r]
+            st = [
+                res.store.get(f"c{i}")
+                for i in range(r * C, (r + 1) * C)
+            ]
+            w = np.array([lease.wants for lease in st])
+            g = np.array([lease.has for lease in st])
+            k = int(kinds[r])
+            expected = oracle_row(
+                k, float(capacity[r]), static_param(res.template),
+                w, np.zeros_like(w), np.ones_like(w),
+            )
+            np.testing.assert_allclose(
+                g, expected, rtol=2e-6, atol=1e-4,
+                err_msg=f"res{r} kind {k}",
+            )
+
+        # Steady state: grants rotate out on the refresh cadence
+        # (refresh_interval=16s at ~1s ticks), dirty rows same-tick.
+        solver.rotate_ticks = SERVER_ROTATE_TICKS
+
+        # Pre-generate per-tick demand churn (5% of resources change
+        # wants), applied through the engine's bulk path as the RPC
+        # handlers' store writes land between ticks.
+        n_ticks = SERVER_WARMUP + TICKS_SERVER
+        churn_rows = [
+            rng.choice(R, CHURN_RESOURCES, replace=False)
+            for _ in range(n_ticks)
+        ]
+        churn_wants = [
+            rng.integers(0, 100, CHURN_RESOURCES * C).astype(np.float64)
+            for _ in range(n_ticks)
+        ]
+
+        def churn(t):
+            # A client refresh's store effect: wants update + expiry
+            # stamp, has preserved (only grants change has).
+            sel = churn_rows[t]
+            edge = (sel[:, None] * C + np.arange(C)).ravel()
+            engine.bulk_refresh(
+                rids[edge], cids[edge],
+                np.full(len(edge), time.time() + 600.0),
+                np.full(len(edge), 16.0),
+                churn_wants[t],
+            )
+            if fused:
+                # The admission window that just wrote these rows
+                # pre-packs them (server._fused_stage's hot path);
+                # the next dispatch's drain still decides WHICH rows
+                # ship — the cache only short-circuits the pack.
+                solver.stage_rids(res_rids[sel])
+
+        tick_ms = []
+        churn_ms = []
+        handles = []
+        phase_mark = {}
+        collects_mark = 0
+        fused_windows = fused_rows = 0
+        phase_samples = [dict(solver.phase_s)]
+        for t in range(n_ticks):
+            if t == SERVER_WARMUP:
+                phase_mark = dict(solver.phase_s)
+                collects_mark = solver.ticks
+                fused_windows = fused_rows = 0
+            t0 = time.perf_counter()
+            churn(t)
+            t1 = time.perf_counter()
+            handles.append(solver.dispatch(resources))
+            fused_windows += solver.last_fused["windows"]
+            fused_rows += solver.last_fused["rows"]
+            if len(handles) >= PIPELINE_DEPTH_SERVER:
+                solver.collect(handles.pop(0))
+            t2 = time.perf_counter()
+            churn_ms.append((t1 - t0) * 1000.0)
+            tick_ms.append((t2 - t0) * 1000.0)
+            phase_samples.append(dict(solver.phase_s))
         t0 = time.perf_counter()
-        churn(t)
-        t1 = time.perf_counter()
-        handles.append(solver.dispatch(resources))
-        if len(handles) >= PIPELINE_DEPTH_SERVER:
-            solver.collect(handles.pop(0))
-        t2 = time.perf_counter()
-        churn_ms.append((t1 - t0) * 1000.0)
-        tick_ms.append((t2 - t0) * 1000.0)
-        phase_samples.append(dict(solver.phase_s))
-    t0 = time.perf_counter()
-    for h in handles:
-        solver.collect(h)
-    drain_ms = (time.perf_counter() - t0) * 1000.0
-    timed = sorted(
-        t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
+        for h in handles:
+            solver.collect(h)
+        drain_ms = (time.perf_counter() - t0) * 1000.0
+        timed = sorted(
+            t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
+        )
+        # Per-phase attribution (phase_attribution): dispatch = sweep
+        # + drain + staging + pack + config + upload + solve; collect
+        # = download + apply; churn is the client-write workload
+        # applied between ticks (included in the headline number
+        # because the reference's per-request decide pays it inline
+        # too — and in the fused variant it carries the window-time
+        # row packs, which is exactly the point).
+        phases = phase_attribution(
+            solver, phase_mark, collects_mark, TICKS_SERVER
+        )
+        phases["churn"] = round(
+            float(np.mean(churn_ms[SERVER_WARMUP:])), 3
+        )
+        return {
+            "timed": timed,
+            "phases": phases,
+            "per_tick": phase_deltas_ms(phase_samples)[SERVER_WARMUP:],
+            "fused_windows": fused_windows,
+            "fused_rows": fused_rows,
+        }
+
+    # Fused variant first; the headline (round-trip, semantics
+    # unchanged since r03) stays the LAST emitted line.
+    fused_run = run(fused=True)
+    ftimed = fused_run["timed"]
+    fmed = float(np.median(ftimed))
+    emit(
+        {
+            "metric": "server_tick_1m_leases_native_store_fused_wall_ms",
+            "value": round(fmed, 3),
+            "unit": "ms",
+            "vs_baseline": round(SERVER_TICK_TARGET_MS / fmed, 3),
+            "selection": f"median_of_{TICKS_SERVER}",
+            "best_ms": round(ftimed[0], 3),
+            "p50_ms": round(float(np.percentile(ftimed, 50)), 3),
+            "p90_ms": round(float(np.percentile(ftimed, 90)), 3),
+            "p99_ms": round(float(np.percentile(ftimed, 99)), 3),
+            "pipeline_depth": PIPELINE_DEPTH_SERVER,
+            "rotate_ticks": SERVER_ROTATE_TICKS,
+            # Fused-window depth over the measured window: windows
+            # folded per tick and rows served from the window-time
+            # pack cache (the same tallies the flight recorder stamps
+            # on each server tick as fused_windows/fused_rows).
+            "fused_windows_per_tick": round(
+                fused_run["fused_windows"] / TICKS_SERVER, 3
+            ),
+            "fused_rows_per_tick": round(
+                fused_run["fused_rows"] / TICKS_SERVER, 3
+            ),
+            "phase_ms": fused_run["phases"],
+        },
+        artifact_extra={
+            "phase_ms_per_tick": fused_run["per_tick"],
+        },
     )
+
+    main_run = run(fused=False)
+    timed = main_run["timed"]
     med = float(np.median(timed))
-    # Per-phase attribution (phase_attribution): dispatch = sweep +
-    # drain + pack + config + upload + solve; collect = download +
-    # apply; churn is the client-write workload applied between ticks
-    # (included in the headline number because the reference's
-    # per-request decide pays it inline too).
-    phases = phase_attribution(
-        solver, phase_mark, collects_mark, TICKS_SERVER
-    )
-    phases["churn"] = round(
-        float(np.mean(churn_ms[SERVER_WARMUP:])), 3
-    )
     emit(
         {
             "metric": "server_tick_1m_leases_native_store_wall_ms",
@@ -574,13 +658,11 @@ def bench_server_tick() -> None:
             "p99_ms": round(float(np.percentile(timed, 99)), 3),
             "pipeline_depth": PIPELINE_DEPTH_SERVER,
             "rotate_ticks": SERVER_ROTATE_TICKS,
-            "phase_ms": phases,
+            "phase_ms": main_run["phases"],
         },
         artifact_extra={
             # Measured window only: one per-phase dict per tick.
-            "phase_ms_per_tick": phase_deltas_ms(phase_samples)[
-                SERVER_WARMUP:
-            ],
+            "phase_ms_per_tick": main_run["per_tick"],
         },
     )
 

@@ -163,16 +163,30 @@ class Coalescer:
         for bi, (req, _) in enumerate(batch):
             for ri, rr in enumerate(req.resource):
                 groups.setdefault(rr.resource_id, []).append((bi, ri, rr))
-        for resource_id, entries in groups.items():
-            for bi, ri, rr in entries:
-                req = batch[bi][0]
-                has = rr.has.capacity if rr.HasField("has") else 0.0
-                lease, res = server._decide(
-                    resource_id,
-                    Request(req.client_id, has, rr.wants, 1,
-                            priority=rr.priority),
-                )
-                slots[bi][ri] = (lease, res.safe_capacity())
+        try:
+            for resource_id, entries in groups.items():
+                for bi, ri, rr in entries:
+                    req = batch[bi][0]
+                    has = rr.has.capacity if rr.HasField("has") else 0.0
+                    lease, res = server._decide(
+                        resource_id,
+                        Request(req.client_id, has, rr.wants, 1,
+                                priority=rr.priority),
+                    )
+                    slots[bi][ri] = (lease, res.safe_capacity())
+        except BaseException:
+            # A partially-applied window leaves the fused staging cache
+            # unable to prove freshness for rows already written (their
+            # dirty flags would be consumed against a pre-write pack);
+            # drop the whole cache — the clean fallback is the
+            # round-trip pack.
+            server._fused_invalidate()
+            raise
+        # Admission-fused staging: the grouped writes just landed, so
+        # pack the touched rows NOW — in this RPC window, overlapped
+        # with whatever tick is in flight — instead of at the next
+        # tick's dispatch (no-op unless the server attached staging).
+        server._fused_stage(groups.keys())
         outs = []
         for (req, _), row in zip(batch, slots):
             out = pb.GetCapacityResponse()

@@ -120,6 +120,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "resolve with one grouped decision pass "
                         "(byte-identical to per-request; 0 disables "
                         "coalescing but keeps shedding)")
+    p.add_argument("--fuse-admission", action="store_true",
+                   help="admission + batch mode: fuse the coalescer's "
+                        "micro-batch windows into the resident "
+                        "solver's dirty-row staging — each window "
+                        "pre-packs the rows it wrote, moving the "
+                        "store pack off the tick's critical path "
+                        "(byte-identical to the round-trip path; "
+                        "needs --admission and --native-store; "
+                        "doc/bench.md)")
+    p.add_argument("--tick-pipeline-depth", type=int, default=2,
+                   help="batch mode: resident ticks kept in flight — "
+                        "tick N's delivery download overlaps the "
+                        "staging and solve of ticks N+1..N+depth-1; "
+                        "1 is the collect-before-dispatch reference "
+                        "pipeline (depth d defers a tick's store "
+                        "write-back d-1 ticks, bounded by the "
+                        "delivery rotation's freshness argument)")
     p.add_argument("--admission-max-rps", type=float, default=0.0,
                    help="admission: hard offered-load budget in "
                         "requests/second — arrivals past it shed "
@@ -207,6 +224,14 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
             "max rps %s)", args.coalesce_window,
             args.admission_max_rps or "unbounded",
         )
+    if args.fuse_admission and admission is None:
+        # Loud, not fatal: the server-side guard ignores fusion without
+        # a coalescing write path, and a silently-ignored perf flag is
+        # an operator trap.
+        log.warning(
+            "--fuse-admission has no effect without --admission "
+            "(the coalescer's windows are the tracked write path)"
+        )
 
     server_id = args.server_id or f"{args.host}:{args.port}"
     server = CapacityServer(
@@ -227,6 +252,8 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         admission=admission,
         flightrec_capacity=args.flightrec_buffer,
         flightrec_dir=args.flightrec_dir or None,
+        fuse_admission=args.fuse_admission,
+        tick_pipeline_depth=args.tick_pipeline_depth,
     )
 
     port = await server.start(

@@ -37,6 +37,7 @@ from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
 from doorman_tpu.server import config as config_mod
 from doorman_tpu.server.election import Election
+from doorman_tpu.solver.engine import PipelinedTicker
 from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
 
 log = logging.getLogger(__name__)
@@ -105,6 +106,8 @@ class CapacityServer(CapacityServicer):
         admission=None,  # Optional[doorman_tpu.admission.Admission]
         flightrec_capacity: int = 512,
         flightrec_dir: Optional[str] = None,
+        fuse_admission: bool = False,
+        tick_pipeline_depth: int = 1,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -168,12 +171,27 @@ class CapacityServer(CapacityServicer):
         # At most one tick in flight (see tick_once).
         self._tick_lock = asyncio.Lock()
         # Device-resident tick path (native batch servers without
-        # priority-band resources): solver, its in-flight tick, and the
-        # cached eligibility decision.
+        # priority-band resources): solver, its in-flight tick pipeline,
+        # and the cached eligibility decision. The pipelines keep up to
+        # `tick_pipeline_depth` ticks in flight per path, so tick N's
+        # delivery download lands concurrent with the staging and solve
+        # of ticks N+1..N+depth-1 (deeper host/device overlap;
+        # engine.PipelinedTicker drops handles whose solver instance
+        # was replaced by a flip). Depth 1 is the reference-equivalent
+        # collect-before-dispatch pipeline (grants land one tick after
+        # their solve); depth d defers a tick's store write-back d-1
+        # further ticks — bounded by the delivery rotation's own
+        # freshness argument (clients refresh far slower than ticks).
+        self._tick_pipeline_depth = max(int(tick_pipeline_depth), 1)
         self._resident = None
-        self._resident_handle = None
+        self._resident_pipe = PipelinedTicker(self._tick_pipeline_depth)
         self._resident_ok_key = None
         self._resident_ok = False
+        # Admission-fused staging: the coalescer's windows pre-pack their
+        # touched rows into the resident solver's staging cache, moving
+        # the store pack off the tick's critical path (engine.FusedStaging;
+        # requires admission coalescing to be the write path).
+        self._fuse_admission = bool(fuse_admission)
         # Optional device mesh for the resident solvers: table rows
         # shard across its devices and each tick is a shard_mapped
         # solve (store contents stay bit-identical to the single-device
@@ -184,7 +202,7 @@ class CapacityServer(CapacityServicer):
         # through their own chunked resident solver; the partition is
         # recomputed with the eligibility key.
         self._resident_wide = None
-        self._resident_wide_handle = None
+        self._resident_wide_pipe = PipelinedTicker(self._tick_pipeline_depth)
         self._wide_ids: set = set()
         # Bumped whenever templates / learning windows / parent leases
         # change outside the stores; the resident solver caches its
@@ -397,9 +415,9 @@ class CapacityServer(CapacityServicer):
         # and any in-flight ticks refer to the old one.
         self._config_epoch += 1
         self._resident = None
-        self._resident_handle = None
+        self._resident_pipe.drop()
         self._resident_wide = None
-        self._resident_wide_handle = None
+        self._resident_wide_pipe.drop()
         self._resident_ok_key = None
         self.last_restore = None
         if is_master and self._persist is not None and self.config is not None:
@@ -493,6 +511,15 @@ class CapacityServer(CapacityServicer):
                 # cadence relative to this server's tick cadence.
                 rotate_ticks=None, tick_interval=self.tick_interval,
             )
+            if self._fuse_admission and self._admission is not None:
+                # Admission-fused staging: the coalescer's windows
+                # pre-pack their touched rows (engine.FusedStaging);
+                # only meaningful when coalescing is the GetCapacity
+                # write path — without admission every write is
+                # untracked and the cache would just be invalidated.
+                self._resident.attach_staging()
+            if self.flightrec is not None:
+                self._resident.on_anomaly = self._solver_anomaly
         return self._resident
 
     def _resident_wide_solver(self):
@@ -511,7 +538,76 @@ class CapacityServer(CapacityServicer):
                 mesh=self._solver_mesh,
                 rotate_ticks=None, tick_interval=self.tick_interval,
             )
+            if self.flightrec is not None:
+                self._resident_wide.on_anomaly = self._solver_anomaly
         return self._resident_wide
+
+    def _solver_anomaly(self, kind: str, detail: dict) -> None:
+        """Tick-engine anomaly hook: an engine-detected invariant at
+        risk (e.g. an out-of-range dirty rid aliasing a live row) lands
+        as a flight-recorder error instant BEFORE the engine raises, so
+        the dump explains the tick that died."""
+        fr = self.flightrec
+        if fr is None:
+            return
+        try:
+            fr.record(
+                t=self._clock(),
+                tick=self._ticks_done,
+                is_master=self.is_master,
+                epoch=self.mastership_epoch,
+                error=f"solver_anomaly:{kind}",
+                detail=detail,
+            )
+        except Exception:
+            log.exception("%s: anomaly record failed", self.id)
+
+    # -- admission-fused staging hooks ---------------------------------
+
+    def _fused_stage(self, resource_ids) -> None:
+        """Coalescer hook, called right after a window's grouped store
+        writes: pre-pack the touched NARROW lane rows into the resident
+        solver's staging cache, moving the pack off the next tick's
+        critical path and into the RPC window that caused it. Runs
+        wherever the grouped pass runs (loop or executor) — the native
+        pack call and the cache are both thread-safe. The drained dirty
+        set remains authoritative for WHICH rows upload; this only
+        short-circuits packing their VALUES (engine.FusedStaging)."""
+        solver = self._resident
+        if (
+            solver is None
+            or solver.staging is None
+            or not self.is_master
+        ):
+            return
+        rids = []
+        for resource_id in resource_ids:
+            res = self.resources.get(resource_id)
+            if (
+                res is not None
+                and resource_id not in self._wide_ids
+                and algo_kind_for(res.template) != AlgoKind.PRIORITY_BANDS
+            ):
+                rids.append(res.store._rid)
+        if rids:
+            solver.stage_rids(rids)
+
+    def _fused_invalidate(self, resource_id: Optional[str] = None) -> None:
+        """Untracked-writer hook: any store write outside the
+        coalescer's grouped pass (release paths, GetServerCapacity's
+        band sub-leases, band sweeps) must drop the touched row's
+        staged pack — a stale entry would ship a pre-write value whose
+        dirty flag the next drain consumes (engine.FusedStaging's
+        freshness contract). resource_id=None drops the whole cache."""
+        solver = self._resident
+        if solver is None or solver.staging is None:
+            return
+        if resource_id is None:
+            solver.staging.invalidate()
+            return
+        res = self.resources.get(resource_id)
+        if res is not None:
+            solver.staging.invalidate(res.store._rid)
 
     def _resident_eligible(self, resources: List[Resource]) -> bool:
         """The resident path covers a native batch server's lane
@@ -548,9 +644,12 @@ class CapacityServer(CapacityServicer):
                        config_epoch: int) -> None:
         """One pipelined resident tick (runs in an executor thread; the
         native engine is mutex-guarded against concurrent RPC writes):
-        collect the previous tick's grants, dispatch the next. Grants
-        land one tick after their solve — the same freshness as a
-        client's refresh cadence.
+        collect the oldest in-flight tick once the pipeline is full,
+        dispatch the next. Grants land `tick_pipeline_depth` ticks
+        after their solve — bounded by the same freshness argument as
+        the delivery rotation (clients refresh far slower than ticks),
+        and in exchange tick N's delivery download overlaps the
+        staging + solve of ticks N+1..N+depth-1.
 
         `solver` is resolved by the CALLER on the event loop, together
         with `resources` and `config_epoch`, so the three are mutually
@@ -558,33 +657,16 @@ class CapacityServer(CapacityServicer):
         while this runs in the executor: the flip orphans the old
         engine, and a step captured before it keeps writing to that
         orphan (harmless) instead of mixing old rows into the new
-        engine. The in-flight handle is stored WITH its solver, and a
+        engine. The pipeline stores each handle WITH its solver, and a
         handle from any other solver instance is dropped, not
         collected — its row ids belong to a different engine."""
-        entry, self._resident_handle = self._resident_handle, None
-        if entry is not None:
-            h_solver, handle = entry
-            if h_solver is solver:
-                solver.collect(handle)
-        handle = solver.dispatch(resources, config_epoch)
-        if self._resident is solver:
-            # A flip between the check and this assignment can still
-            # attach a stale entry; the identity check above makes that
-            # benign (the next step drops it uncollected).
-            self._resident_handle = (solver, handle)
+        self._resident_pipe.step(solver, resources, config_epoch)
 
     def _resident_wide_step(self, solver, resources: List[Resource],
                             config_epoch: int) -> None:
-        """One pipelined wide (chunked) tick; same collect-then-dispatch
-        pipelining and flip-safety rules as _resident_step."""
-        entry, self._resident_wide_handle = self._resident_wide_handle, None
-        if entry is not None:
-            h_solver, handle = entry
-            if h_solver is solver:
-                solver.collect(handle)
-        handle = solver.dispatch(resources, config_epoch)
-        if self._resident_wide is solver:
-            self._resident_wide_handle = (solver, handle)
+        """One pipelined wide (chunked) tick; same pipelining and
+        flip-safety rules as _resident_step."""
+        self._resident_wide_pipe.step(solver, resources, config_epoch)
 
     @property
     def _ticks_done(self) -> int:
@@ -690,9 +772,9 @@ class CapacityServer(CapacityServicer):
             resident = self._resident_solver() if narrow_res else None
             wide = self._resident_wide_solver() if wide_res else None
             if not narrow_res:
-                self._resident_handle = None
+                self._resident_pipe.drop()
             if not wide_res:
-                self._resident_wide_handle = None
+                self._resident_wide_pipe.drop()
             epoch = self._config_epoch
 
             def resident_or_fallback():
@@ -732,8 +814,8 @@ class CapacityServer(CapacityServicer):
                         "wide resources", self.id,
                     )
                     self._resident_ok_key = None
-                    self._resident_handle = None
-                    self._resident_wide_handle = None
+                    self._resident_pipe.drop()
+                    self._resident_wide_pipe.drop()
                     run_tick()
 
             # copy_context: executor threads don't inherit contextvars,
@@ -814,6 +896,20 @@ class CapacityServer(CapacityServicer):
         }
         if phases:
             rec["phases"] = phases
+        if self._resident is not None:
+            # Fused-window depth of the last resident dispatch: windows
+            # folded into the tick and rows served from the window-time
+            # pack cache — the new staging pipeline stage is triaged
+            # like the others (its lap rides `phases` as "staging").
+            lf = self._resident.last_fused
+            if lf.get("windows") or lf.get("rows"):
+                rec["fused_windows"] = int(lf.get("windows", 0))
+                rec["fused_rows"] = int(lf.get("rows", 0))
+        depth_used = max(
+            len(self._resident_pipe), len(self._resident_wide_pipe)
+        )
+        if depth_used > 1:
+            rec["pipeline_in_flight"] = depth_used
         if self._admission is not None:
             admitted = 0
             shed_by_band: Dict[str, int] = {}
@@ -912,12 +1008,12 @@ class CapacityServer(CapacityServicer):
         while True:
             await asyncio.sleep(self.tick_interval)
             if not self.is_master:
-                # A flip's clear can race the executor attaching one
+                # A flip's drop can race the executor appending one
                 # last (stale) entry; no tick runs on a standby, so
                 # drop it here or it pins the orphaned engine and its
                 # device buffer for the whole standby period.
-                self._resident_handle = None
-                self._resident_wide_handle = None
+                self._resident_pipe.drop()
+                self._resident_wide_pipe.drop()
                 continue
             try:
                 await self.tick_once()
@@ -1102,6 +1198,9 @@ class CapacityServer(CapacityServicer):
                         ),
                     )
                     granted += lease.has
+                # Untracked writes: the band sub-lease decides above
+                # bypass the coalescer's stage (see _fused_invalidate).
+                self._fused_invalidate(req.resource_id)
                 resp = out.response.add()
                 resp.resource_id = req.resource_id
                 resp.gets.expiry_time = int(lease.expiry)
@@ -1165,6 +1264,9 @@ class CapacityServer(CapacityServicer):
                     res.release(bkey)
                     if self._persist is not None:
                         self._persist.record_release(resource_id, bkey)
+                # Untracked write: a staged pack of this row predates
+                # the release (see _fused_invalidate).
+                self._fused_invalidate(resource_id)
             return out
         finally:
             self.on_request("ReleaseCapacity", self._clock() - start, err)
@@ -1193,6 +1295,8 @@ class CapacityServer(CapacityServicer):
                 # first so vanished servers actually disappear even in
                 # immediate mode (where no batch tick cleans stores).
                 res.store.clean()
+                # Untracked removal (see _fused_invalidate).
+                self._fused_invalidate(resource_id)
                 swept.add(resource_id)
             if res is None or not any(
                 res.store.has_client(_band_key(server_id, p)) for p in prios
@@ -1383,6 +1487,23 @@ class CapacityServer(CapacityServicer):
                 else None
             ),
             "ticks": self._ticks_done,
+            # Tick-pipeline shape: configured depth and what is in
+            # flight right now (resident + wide pipelines).
+            "tick_pipeline": {
+                "depth": self._tick_pipeline_depth,
+                "in_flight": (
+                    len(self._resident_pipe)
+                    + len(self._resident_wide_pipe)
+                ),
+            },
+            # Admission-fused staging counters (None: fusion off or the
+            # resident path not active yet); see doc/bench.md.
+            "fused_staging": (
+                self._resident.staging.status()
+                if self._resident is not None
+                and self._resident.staging is not None
+                else None
+            ),
             # Ticks the resident solver served without device work (the
             # idle fast path); a busy server shows 0 here.
             "idle_ticks": (

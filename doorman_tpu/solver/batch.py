@@ -41,31 +41,40 @@ from doorman_tpu.utils.transfer import chunked_device_get
 DENSE_MAX_K = 4096
 
 
-def _dense_solver(use_pallas: bool):
+def _dense_solver(use_pallas: bool, lanes=None, with_fair: bool = False):
     """Jitted dense solve with the output sliced to the filled extent
-    inside the same executable (one dispatch, download-sized output)."""
-    fn = _dense_solvers.get(use_pallas)
+    inside the same executable (one dispatch, download-sized output).
+    `lanes`/`with_fair` are the host-knowledge fast paths of
+    solver.lanes (skip absent algorithm lanes; water-fill only the
+    FAIR_SHARE rows) — byte-identical to the full solve; the pallas
+    kernel ignores them (its fused body computes all lanes in VMEM)."""
+    key = (use_pallas, lanes, with_fair)
+    fn = _dense_solvers.get(key)
     if fn is None:
+        from functools import partial
+
         if use_pallas:
             from doorman_tpu.solver.pallas_dense import solve_dense_pallas
 
-            solve = solve_dense_pallas
+            @partial(jax.jit, static_argnums=(1, 2))
+            def fn(dense, n_rows, kfill, fair_rows=None):
+                return solve_dense_pallas(dense)[:n_rows, :kfill]
+
         else:
             from doorman_tpu.solver.dense import solve_dense
 
-            solve = solve_dense
+            @partial(jax.jit, static_argnums=(1, 2))
+            def fn(dense, n_rows, kfill, fair_rows=None):
+                return solve_dense(
+                    dense, lanes=lanes,
+                    fair_rows=fair_rows if with_fair else None,
+                )[:n_rows, :kfill]
 
-        from functools import partial
-
-        @partial(jax.jit, static_argnums=(1, 2))
-        def fn(dense, n_rows, kfill):
-            return solve(dense)[:n_rows, :kfill]
-
-        _dense_solvers[use_pallas] = fn
+        _dense_solvers[key] = fn
     return fn
 
 
-_dense_solvers: Dict[bool, Callable] = {}
+_dense_solvers: Dict[tuple, Callable] = {}
 
 
 def _rebuild_grant_map(
@@ -325,7 +334,7 @@ class BatchSolver:
         # recompile every time a resource or client count drifts by one.
         n_rows = min(R, -(-n_spec // 8) * 8)
         kfill = min(K, -(-int(counts.max()) // 8) * 8)
-        return Snapshot(
+        snap = Snapshot(
             edges=None,
             resources=None,
             edge_keys=[],
@@ -339,6 +348,17 @@ class BatchSolver:
             pos=pos,
             dense_fill=(n_rows, kfill),
         )
+        # Host lane knowledge for the solve (solver.lanes fast paths):
+        # the specs name every algorithm kind present, and the fair rows
+        # pad to a bucketed static shape (repeats are harmless).
+        snap.dense_lanes = frozenset(int(k) for k in np.unique(kind[:n_spec]))
+        fair = np.nonzero(
+            kind[:n_spec] == int(AlgoKind.FAIR_SHARE)
+        )[0].astype(np.int32)
+        snap.dense_fair = (
+            np.resize(fair, _bucket(len(fair), 8)) if len(fair) else None
+        )
+        return snap
 
     def _snapshot_priority(
         self, prio_res: List[Resource]
@@ -487,9 +507,11 @@ class BatchSolver:
                 and snap.dense.wants.dtype == jnp.float32
             )
             n_rows, kfill = snap.dense_fill
-            dense_gets = _dense_solver(use_pallas)(
-                snap.dense, n_rows, kfill
-            )
+            lanes = getattr(snap, "dense_lanes", None)
+            fair = getattr(snap, "dense_fair", None)
+            dense_gets = _dense_solver(
+                use_pallas, lanes, fair is not None
+            )(snap.dense, n_rows, kfill, fair)
             got = chunked_device_get(dense_gets)
             gets = got[snap.ridx, snap.pos]
         else:

@@ -42,8 +42,14 @@ class DenseBatch:
     static_capacity: jax.Array  # [R]
 
 
-def solve_dense(batch: DenseBatch) -> jax.Array:
-    """Grants [R, K]; same lane semantics as kernels.solve_edges."""
+def solve_dense(batch: DenseBatch, lanes=None, fair_rows=None) -> jax.Array:
+    """Grants [R, K]; same lane semantics as kernels.solve_edges.
+
+    `lanes` (a frozenset of AlgoKind ints present in the batch) and
+    `fair_rows` (the FAIR_SHARE row indices, padded to a static shape)
+    are the host-knowledge fast paths of solve_lanes: absent lanes are
+    skipped and the water-fill bisection runs only over the fair rows —
+    both byte-identical to the default full computation."""
     return solve_lanes(
         batch.wants,
         batch.has,
@@ -56,6 +62,8 @@ def solve_dense(batch: DenseBatch) -> jax.Array:
         segsum=lambda v: v.sum(axis=1),
         segmax=lambda v: v.max(axis=1),
         expand=lambda totals: totals[:, None],
+        lanes=lanes,
+        fair_rows=fair_rows,
     )
 
 
@@ -118,9 +126,12 @@ def chunked_reduces(row_seg: jax.Array, num_segments: int):
     return segsum, segmax
 
 
-def solve_chunked(batch: ChunkedDenseBatch) -> jax.Array:
+def solve_chunked(batch: ChunkedDenseBatch, lanes=None) -> jax.Array:
     """Grants [R, K]; identical lane semantics — only the reductions
-    differ (two-level instead of one row reduction)."""
+    differ (two-level instead of one row reduction). `lanes` is the
+    static kind-subset fast path (see solve_dense); the chunked layout
+    has no fair-row compaction (a segment spans rows, so the water-fill
+    cannot gather per-row)."""
     seg = batch.row_seg
     S = batch.capacity.shape[0]
     segsum, segmax = chunked_reduces(seg, S)
@@ -137,6 +148,7 @@ def solve_chunked(batch: ChunkedDenseBatch) -> jax.Array:
         segsum=segsum,
         segmax=segmax,
         expand=lambda totals: totals[seg][:, None],
+        lanes=lanes,
     )
 
 

@@ -16,7 +16,7 @@ on representable inputs.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +87,36 @@ def waterfill_level(
     return jnp.where(underloaded, max_ratio, level)
 
 
+def waterfill_level_compact(
+    wants: jax.Array,  # [R, K], row layout (one row = one resource)
+    weights: jax.Array,  # [R, K]
+    active: jax.Array,  # [R, K] bool
+    capacity: jax.Array,  # [R]
+    fair_rows: jax.Array,  # [F] int32, every FAIR_SHARE row (repeats ok)
+) -> jax.Array:
+    """Row-layout water level with the bisection restricted to the rows
+    that actually run FAIR_SHARE. The per-row arithmetic of
+    `waterfill_level` is independent across rows in the row layout
+    (segsum/segmax reduce within a row), so gathering the fair rows,
+    bisecting on the [F, K] subtable, and scattering the levels back is
+    BIT-IDENTICAL to the full-table bisection for those rows — while the
+    other rows (whose level the where-chain never selects) skip the
+    ~50-pass bisection entirely. `fair_rows` may repeat indices
+    (padding to a bucketed static shape): duplicate scatters write the
+    same value. Non-fair rows read level 0, which no lane consumes."""
+    wf = jnp.take(wants, fair_rows, axis=0)
+    sf = jnp.take(weights, fair_rows, axis=0)
+    af = jnp.take(active, fair_rows, axis=0)
+    cf = jnp.take(capacity, fair_rows, axis=0)
+    lvl = waterfill_level(
+        wf, sf, af, cf,
+        segsum=lambda v: v.sum(axis=1),
+        segmax=lambda v: v.max(axis=1),
+        expand=lambda totals: totals[:, None],
+    )
+    return jnp.zeros_like(capacity).at[fair_rows].set(lvl, mode="drop")
+
+
 def solve_lanes(
     wants: jax.Array,  # lease-shaped
     has: jax.Array,
@@ -99,8 +129,24 @@ def solve_lanes(
     segsum: Reduce,
     segmax: Reduce,
     expand: Expand,
+    lanes: "Optional[frozenset]" = None,
+    fair_rows: "Optional[jax.Array]" = None,
 ) -> jax.Array:
-    """Grants, lease-shaped; inactive lanes produce 0."""
+    """Grants, lease-shaped; inactive lanes produce 0.
+
+    `lanes`: the set of AlgoKind values PRESENT in `algo_kind` (host
+    knowledge, e.g. the resident solver's config mirror). Lanes not in
+    the set are skipped — byte-identical by construction, since the
+    where-chain would never select them — which matters because the
+    FAIR_SHARE water-fill alone costs ~50 full-table passes. None (the
+    default, and what a caller without host kind knowledge must pass)
+    computes every lane. The LEARN replay is always applied: learning is
+    time-driven per tick, not part of the static kind set.
+
+    `fair_rows`: row-layout callers (one row = one resource) may pass
+    the FAIR_SHARE row indices to restrict the water-fill bisection to
+    those rows (waterfill_level_compact — bit-identical per row).
+    Ignored unless the FAIR_SHARE lane runs."""
     dtype = wants.dtype
     zero = jnp.zeros((), dtype)
     tiny = jnp.finfo(dtype).tiny
@@ -109,54 +155,83 @@ def solve_lanes(
     sub = jnp.where(active, subclients, zero)
     cap_e = expand(capacity)
 
+    def need(kind_value) -> bool:
+        return lanes is None or int(kind_value) in lanes
+
     sum_wants = segsum(wants)  # per-resource
-    sum_has = segsum(has)
-    count = segsum(sub)
-
-    # ---- Lane: NO_ALGORITHM — everyone gets what they want.
-    gets_none = wants
-
-    # ---- Lane: STATIC — per-client configured cap.
-    gets_static = jnp.minimum(expand(static_capacity), wants)
 
     # ---- Lane: LEARN — replay the client's self-reported grant.
     gets_learn = has
+
+    lane_outs = []
+
+    # ---- Lane: NO_ALGORITHM — everyone gets what they want.
+    if need(AlgoKind.NO_ALGORITHM):
+        lane_outs.append((AlgoKind.NO_ALGORITHM, wants))
+
+    # ---- Lane: STATIC — per-client configured cap.
+    if need(AlgoKind.STATIC):
+        lane_outs.append(
+            (AlgoKind.STATIC, jnp.minimum(expand(static_capacity), wants))
+        )
+
+    # `free` feeds the proportional lanes; `fits` the topup/fair lanes.
+    if need(AlgoKind.PROPORTIONAL_SHARE) or need(AlgoKind.PROPORTIONAL_TOPUP):
+        free = jnp.maximum(cap_e - (expand(segsum(has)) - has), zero)
+    if need(AlgoKind.PROPORTIONAL_TOPUP) or need(AlgoKind.FAIR_SHARE):
+        fits = expand(sum_wants <= capacity)
 
     # ---- Lane: PROPORTIONAL_SHARE (simulation semantics,
     # algo_proportional.py:31-65): pure scaling by capacity / all_wants in
     # overload, clamped by the free capacity as seen from the snapshot
     # (own previous grant excluded from the outstanding-lease sum).
-    free = jnp.maximum(cap_e - (expand(sum_has) - has), zero)
-    underloaded = expand(sum_wants < capacity)
-    scaled = wants * (cap_e / expand(jnp.maximum(sum_wants, tiny)))
-    gets_prop = jnp.where(
-        underloaded, jnp.minimum(wants, free), jnp.minimum(scaled, free)
-    )
+    if need(AlgoKind.PROPORTIONAL_SHARE):
+        underloaded = expand(sum_wants < capacity)
+        scaled = wants * (cap_e / expand(jnp.maximum(sum_wants, tiny)))
+        lane_outs.append((
+            AlgoKind.PROPORTIONAL_SHARE,
+            jnp.where(
+                underloaded,
+                jnp.minimum(wants, free),
+                jnp.minimum(scaled, free),
+            ),
+        ))
+
+    # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
+    if need(AlgoKind.FAIR_SHARE):
+        if fair_rows is not None:
+            level = waterfill_level_compact(
+                wants, sub, active, capacity, fair_rows
+            )
+        else:
+            level = waterfill_level(
+                wants, sub, active, capacity, segsum, segmax, expand
+            )
+        lane_outs.append((
+            AlgoKind.FAIR_SHARE,
+            jnp.where(fits, wants, jnp.minimum(wants, expand(level) * sub)),
+        ))
 
     # ---- Lane: PROPORTIONAL_TOPUP (Go semantics, snapshot form,
     # algorithm.go:213-292): equal share + top-up funded by clients under
     # their equal share.
-    equal = (cap_e / expand(jnp.maximum(count, tiny))) * sub
-    under = wants < equal
-    extra_capacity = expand(segsum(jnp.where(under, equal - wants, zero)))
-    extra_need = expand(segsum(jnp.where(under, zero, wants - equal)))
-    topped = equal + (wants - equal) * (
-        extra_capacity / jnp.maximum(extra_need, tiny)
-    )
-    fits = expand(sum_wants <= capacity)
-    gets_topup = jnp.where(
-        fits | (wants <= equal),
-        jnp.minimum(wants, free),
-        jnp.minimum(topped, free),
-    )
-
-    # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
-    level = waterfill_level(
-        wants, sub, active, capacity, segsum, segmax, expand
-    )
-    gets_fair = jnp.where(
-        fits, wants, jnp.minimum(wants, expand(level) * sub)
-    )
+    if need(AlgoKind.PROPORTIONAL_TOPUP):
+        count = segsum(sub)
+        equal = (cap_e / expand(jnp.maximum(count, tiny))) * sub
+        under = wants < equal
+        extra_capacity = expand(segsum(jnp.where(under, equal - wants, zero)))
+        extra_need = expand(segsum(jnp.where(under, zero, wants - equal)))
+        topped = equal + (wants - equal) * (
+            extra_capacity / jnp.maximum(extra_need, tiny)
+        )
+        lane_outs.append((
+            AlgoKind.PROPORTIONAL_TOPUP,
+            jnp.where(
+                fits | (wants <= equal),
+                jnp.minimum(wants, free),
+                jnp.minimum(topped, free),
+            ),
+        ))
 
     # A where-chain rather than jnp.select: identical semantics, and it
     # lowers on every backend pallas targets (select's argmax does not).
@@ -165,13 +240,7 @@ def solve_lanes(
     # non-ref closure constant); a Python int stays a weak-typed literal.
     kind_e = expand(algo_kind)
     gets = jnp.zeros_like(wants)
-    for kind_value, lane in (
-        (AlgoKind.NO_ALGORITHM, gets_none),
-        (AlgoKind.STATIC, gets_static),
-        (AlgoKind.PROPORTIONAL_SHARE, gets_prop),
-        (AlgoKind.FAIR_SHARE, gets_fair),
-        (AlgoKind.PROPORTIONAL_TOPUP, gets_topup),
-    ):
+    for kind_value, lane in lane_outs:
         gets = jnp.where(kind_e == int(kind_value), lane, gets)
     # Learning-mode resources replay reported grants regardless of lane
     # (reference resource.go:108-111).

@@ -1,4 +1,4 @@
-"""Device-resident steady-state tick solver.
+"""Device-resident steady-state tick solver (narrow rows).
 
 The BatchSolver (solver/batch.py) re-uploads every lease and downloads
 every grant each tick — robust, but at 1M leases the host link dominates
@@ -6,13 +6,23 @@ the tick (the round-trip costs ~25x the device solve). This module keeps
 the dense [R, K] demand tables RESIDENT on device between ticks and
 moves only what changed:
 
-  upload:   rows whose solver-visible inputs changed since the last tick
+  staging:  rows whose solver-visible inputs changed since the last tick
             (the native engine tracks dirtiness per resource — pure
             expiry refreshes with unchanged demand don't count), as a
-            row scatter into the donated tables;
+            row scatter into the donated tables. With admission-fused
+            staging (engine.FusedStaging) the row pack happens at the
+            RPC window that caused the change, off the tick's critical
+            path; the drained dirty set remains the source of truth for
+            WHICH rows ship. Wants-only blocks ship as bf16 when that
+            round-trips exactly (engine.bf16_exact — byte-identical at
+            a quarter of the f64 bytes).
   solve:    the full table every tick (the device solve is cheap; `has`
-            chains on device from the previous tick's grants);
-  download: only the grant rows being DELIVERED this tick — every dirty
+            chains on device from the previous tick's grants). The
+            executable is shaped by host config knowledge: absent
+            algorithm lanes are skipped and the FAIR_SHARE water-fill
+            bisection runs only over the fair rows (solver.lanes —
+            byte-identical by construction).
+  delivery: only the grant rows being DELIVERED this tick — every dirty
             row (so demand changes land in the store within one tick),
             every row whose effective config changed (capacity cut,
             parent-lease expiry, learning-mode flip: the reference
@@ -40,35 +50,40 @@ and re-delivers it). The engine itself is mutex-guarded, so dispatch and
 collect may run in an executor thread while RPC handlers keep mutating
 leases on the event loop.
 
-Replaces the reference's per-request algorithm invocation at scale
-(go/server/doorman/server.go:732-817); the lane math is byte-identical
-to BatchSolver's (both call solver.dense/solve_lanes).
+The stage skeleton (sweep -> drain -> config -> idle gate -> launch)
+and the shared chokepoints (placement, config mirror, rotation, fused
+staging, collect) live in solver/engine.py; this module owns the dense
+row layout. Replaces the reference's per-request algorithm invocation at
+scale (go/server/doorman/server.go:732-817); the lane math is
+byte-identical to BatchSolver's (both call solver.dense/solve_lanes).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.core.resource import Resource
 from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.obs.phases import PhaseRecorder
 
 # Dense row padding (shared rule with solver.batch._round_rows).
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
+from doorman_tpu.solver.engine import (
+    TickEngineBase,
+    TickHandle,
+    bf16_exact,
+    ceil_to,
+    landed_rows,
+    place,
+)
+from doorman_tpu.solver.engine import _BF16
 
-
-def _ceil_to(n: int, m: int) -> int:
-    """Round up to a multiple of m (>= m). Per-tick scatter/delivery
-    shapes use multiples, not powers of two: the host<->device link is
-    the tick's bottleneck, and a power-of-two bucket ships up to 2x the
-    bytes for the same work (2048x128 vs 1280x104 is half a megabyte per
-    tick at the bench shape). Multiples keep the recompile count bounded
-    (shapes per axis <= axis_max / m) while tracking the true size."""
-    return max(m, ((n + m - 1) // m) * m)
+# Back-compat aliases (resident_wide and tests import these from here).
+_ceil_to = ceil_to
 
 
 class ResidentOverflow(RuntimeError):
@@ -76,70 +91,15 @@ class ResidentOverflow(RuntimeError):
     to the BatchSolver path (its edge layout has no width limit)."""
 
 
-def place(arr, *, device=None, sharding=None):
-    """The resident solvers' single placement chokepoint: every device
-    table, config column, and staged per-tick block lands through here,
-    so the single-device path (explicit device or backend default) and
-    the mesh path (a NamedSharding) cannot drift apart."""
-    import jax
-
-    if sharding is not None:
-        return jax.device_put(arr, sharding)
-    return jax.device_put(arr, device)
-
-
-def landed_rows(handle: "TickHandle") -> np.ndarray:
-    """Land a tick's download into [n_sel, W] float64 rows (shared by
-    the narrow and wide collect paths). Single-device ticks land as one
-    padded [Sb, W] slab; mesh ticks as [n_dev, Sb, W] per-shard blocks
-    whose real rows concatenate in shard-major order — exactly the
-    sorted order of handle.sel_rows."""
-    from doorman_tpu.utils.transfer import land_parts
-
-    gets = np.asarray(land_parts(handle.out), np.float64)
-    if handle.shard_counts is None:
-        return gets[: handle.n_sel]
-    parts = [
-        gets[d, : int(c)]
-        for d, c in enumerate(handle.shard_counts)
-        if int(c)
-    ]
-    if not parts:
-        return np.zeros((0, gets.shape[-1]))
-    return np.concatenate(parts)
-
-
-@dataclass
-class TickHandle:
-    """One in-flight tick: the device output plus everything collect()
-    needs to write it back. out=None marks an idle tick (nothing to
-    download or apply)."""
-
-    out: object  # list of device slices of [Sb, kfill], copies in flight
-    sel_rows: np.ndarray  # [n_sel] row indices (unique)
-    rids: np.ndarray  # [n_sel] engine resource handles
-    versions: np.ndarray  # [n_sel] membership epochs at upload
-    keep_has: np.ndarray  # [n_sel] uint8 (learning rows)
-    n_sel: int = 0
-    dispatched_at: float = 0.0
-    collected: bool = False
-    # Wide (chunked) ticks only: the chunk number per selected row
-    # (solver.resident_wide writes back via apply_chunks).
-    chunks: "np.ndarray | None" = None
-    # Mesh ticks only: real delivered rows per shard. out lands as
-    # [n_dev, Sb, W] (one padded block per shard) and collect
-    # reassembles the first shard_counts[d] rows of each block — in
-    # shard-major order, which IS the sorted global order of sel_rows.
-    shard_counts: "np.ndarray | None" = None
-
-
-class ResidentDenseSolver:
+class ResidentDenseSolver(TickEngineBase):
     """Steady-state batched ticks with the device as the table of record.
 
     Covers lane-algorithm resources backed by one native StoreEngine;
     PRIORITY_BANDS resources take the BatchSolver's priority part, and
     Python-store servers take the BatchSolver path entirely.
     """
+
+    component = "resident"
 
     def __init__(
         self,
@@ -153,189 +113,32 @@ class ResidentDenseSolver:
         tick_interval: "float | None" = None,
         download_dtype=None,
     ):
-        import jax
-
-        if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
-            raise RuntimeError(
-                "ResidentDenseSolver dtype=float64 requires jax_enable_x64"
-            )
-        self._engine = engine
-        self._dtype = np.dtype(dtype)
-        self._device = device
-        # A parallel.mesh Mesh shards the table rows (and the per-tick
-        # scatter/delivery traffic) across every mesh axis; rows are
-        # independent here (one row = one resource), so the sharded
-        # tick needs no collectives — pure scale-out. `device` is
-        # ignored under a mesh (placement follows the mesh's devices).
-        self._mesh = mesh
-        self._meshrows = None
-        if mesh is not None:
-            from doorman_tpu.solver.resident_mesh import MeshRows
-
-            self._meshrows = MeshRows(mesh)
-        self._rot_shard_cursors: "np.ndarray | None" = None
-        self._clock = clock
-        # rotate_ticks=None derives the rotation from the config each
-        # time templates are read: delivery rides the fastest refresh
-        # cadence (min refresh_interval / tick_interval, capped at 64),
-        # which is the staleness the reference's own information model
-        # already has — client-reported state lags by one refresh
-        # interval. An explicit int pins it (bench tuning).
-        self._tick_interval = tick_interval
-        self._rotate_override: "int | None" = None
-        if rotate_ticks is None:
-            self._rotate = 8
-        else:
-            self.rotate_ticks = rotate_ticks
-        # Grants download in the solve dtype by default: bf16 would halve
-        # the bytes but its ~0.4% rounding can push sum(has) over
-        # capacity in the store; correctness wins by default.
-        self._out_dtype = download_dtype or self._dtype
-        self.ticks = 0
-        self.idle_ticks = 0  # ticks served by the idle fast path
-        self.last_tick_seconds = 0.0
-        self._quiet_ticks = 0
-        # Per-phase wall-time accumulators (seconds) for the perf
-        # breakdown; bench.py reports them per tick, and every lap also
-        # lands in the default metrics registry and the trace ring
-        # (obs.phases.PhaseRecorder). All keys exist from construction
-        # so readers (e.g. /debug/status on the event loop) can iterate
-        # while a tick in an executor thread updates values — the dict
-        # never resizes, only stores floats.
-        self.phase_s: Dict[str, float] = {
-            name: 0.0
-            for name in (
-                "sweep", "drain", "config", "pack", "upload", "solve",
-                "download", "apply", "rebuild",
-            )
-        }
-
+        super().__init__(
+            engine,
+            dtype=dtype,
+            device=device,
+            mesh=mesh,
+            clock=clock,
+            rotate_ticks=rotate_ticks,
+            tick_interval=tick_interval,
+            download_dtype=download_dtype,
+        )
         self._rows: List[Resource] = []
         self._row_lut = np.full(1, -1, np.int64)
         self._R = 0  # real rows
         self._Rp = 0  # padded rows
         self._K = 8
         self._kfill = 8
-        self._rot_cursor = 0
-        self._just_rebuilt = False
         self._uploaded_versions = np.zeros(0, np.uint64)
         self._rids = np.zeros(0, np.int32)
 
         # Device tables (donated through each tick executable).
         self._wants = self._has = self._sub = self._act = None
-        # Per-row config, host mirror + device handle.
-        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
-        self._cap_d = self._kind_d = self._statc_d = self._learn_d = None
-        self._refresh = None
-        self._cap_raw = self._learn_end = self._parent_exp = None
-        self._config_epoch = -1
-
-        self._tick_fns: Dict[Tuple[int, int, int], Callable] = {}
-
-    # -- configuration ------------------------------------------------
-
-    @property
-    def rotate_ticks(self) -> int:
-        return self._rotate
-
-    @rotate_ticks.setter
-    def rotate_ticks(self, value: int) -> None:
-        self._rotate_override = max(int(value), 1)
-        self._rotate = self._rotate_override
-
-    def _put(self, arr, sharding=None):
-        return place(arr, device=self._device, sharding=sharding)
-
-    def _put_rows(self, arr):
-        """Row-axis placement: table rows / per-row config split over
-        the mesh (axis 0 is always a multiple of the device count),
-        per-shard staged blocks split by their leading device axis.
-        Without a mesh this is the plain single-device put."""
-        if self._meshrows is None:
-            return self._put(arr)
-        return self._put(arr, self._meshrows.shard0(np.ndim(arr)))
-
-    def _read_config(self, rows: Sequence[Resource]) -> None:
-        """One pass over the templates (10k protobuf reads cost ~30ms at
-        1M-lease scale, so this runs only when the caller's config epoch
-        moves, not per tick)."""
-        Rp = self._Rp
-        dtype = self._dtype
-        cap = np.zeros(Rp, dtype)
-        kind = np.zeros(Rp, np.int32)
-        statc = np.zeros(Rp, dtype)
-        refresh = np.full(Rp, 1.0, np.float64)
-        learn_end = np.zeros(Rp, np.float64)
-        parent_exp = np.full(Rp, np.inf, np.float64)
-        for i, r in enumerate(rows):
-            tpl = r.template
-            cap[i] = tpl.capacity
-            kind[i] = algo_kind_for(tpl)
-            statc[i] = static_param(tpl)
-            refresh[i] = float(tpl.algorithm.refresh_interval)
-            learn_end[i] = r.learning_mode_end
-            if r.parent_expiry is not None:
-                parent_exp[i] = r.parent_expiry
-        self._cap_raw = cap
-        self._learn_end = learn_end
-        self._parent_exp = parent_exp
-        self._refresh = refresh
-        if self._rotate_override is None and self._tick_interval and rows:
-            # Delivery must cover the whole table at least once per
-            # refresh interval, else a client can refresh against a
-            # store row older than its own cadence. Capped at 64:
-            # beyond that the per-tick rotation slice is already tiny
-            # (R/64 rows), while an uncapped derivation from a
-            # slow-refresh config (say 3600s refresh at 50ms ticks)
-            # would stretch a full delivery cycle — and the idle fast
-            # path's two-rotation threshold — into the tens of
-            # thousands of ticks.
-            self._rotate = max(
-                1,
-                min(
-                    int(refresh[: len(rows)].min() / self._tick_interval),
-                    64,
-                ),
-            )
-        if self._kind_h is None or not np.array_equal(kind, self._kind_h):
-            self._kind_h, self._kind_d = kind, self._put_rows(kind)
-        if self._statc_h is None or not np.array_equal(statc, self._statc_h):
-            self._statc_h, self._statc_d = statc, self._put_rows(statc)
-
-    def _refresh_config(
-        self, rows: Sequence[Resource], config_epoch: int, now: float
-    ) -> "np.ndarray | None":
-        """Per-tick config view: templates re-read only when the epoch
-        moved; time-driven drift (learning-mode end, parent-lease
-        expiry) recomputed vectorized every tick.
-
-        Returns the rows whose effective config changed this tick (they
-        must be DELIVERED this tick — the solve sees new config
-        immediately, and the store of record must too, matching the
-        reference's config-at-next-decide semantics,
-        go/server/doorman/resource.go:117-140). None means "everything
-        may have changed" (epoch moved / first tick): deliver all."""
-        epoch_moved = (
-            config_epoch != self._config_epoch or self._cap_raw is None
-        )
-        if epoch_moved:
-            self._config_epoch = config_epoch
-            self._read_config(rows)
-        # Expired parent lease => capacity 0 (core/resource.py:capacity).
-        cap = np.where(
-            self._parent_exp < now, 0.0, self._cap_raw
-        ).astype(self._dtype)
-        learn = self._learn_end > now
-        if epoch_moved or self._cap_h is None or self._learn_h is None:
-            changed: "np.ndarray | None" = None
-        else:
-            mask = (cap != self._cap_h) | (learn != self._learn_h)
-            changed = np.nonzero(mask)[0]
-        if self._cap_h is None or not np.array_equal(cap, self._cap_h):
-            self._cap_h, self._cap_d = cap, self._put_rows(cap)
-        if self._learn_h is None or not np.array_equal(learn, self._learn_h):
-            self._learn_h, self._learn_d = learn, self._put_rows(learn)
-        return changed
+        # FAIR_SHARE row indices (device, padded; see solver.lanes
+        # waterfill_level_compact) — rebuilt when the config's kind
+        # vector moves.
+        self._fair_rows_d = None
+        self._fair_kinds = None
 
     # -- build / rebuild ----------------------------------------------
 
@@ -359,9 +162,9 @@ class ResidentDenseSolver:
             # Equal row blocks per shard; fresh per-shard rotation
             # cursors (the old ones indexed the old partition).
             self._Rp = self._meshrows.round_rows(self._Rp)
-            self._rot_shard_cursors = np.zeros(
-                self._meshrows.n_dev, np.int64
-            )
+            self._rotation.reset(self._meshrows.n_dev)
+        else:
+            self._rotation.reset()
         self._rids = np.full(self._Rp, -1, np.int32)
         for i, r in enumerate(rows):
             self._rids[i] = r.store._rid
@@ -371,7 +174,11 @@ class ResidentDenseSolver:
         # reaching the device. Post-drain writes re-flag and upload next
         # tick; the pack below reads state at least as fresh as the
         # drain point. drain2 so dirty_full flags reset with the drain.
+        # A rebuild also invalidates the fused pack cache: cached rows
+        # were packed against the old layout's lane width.
         self._engine.drain_dirty2()
+        if self._staging is not None:
+            self._staging.invalidate()
         # One C call packs all rows; a second pass only if K was too
         # small for the widest resource.
         K = self._K
@@ -395,58 +202,74 @@ class ResidentDenseSolver:
                 f"cap {DENSE_MAX_K}"
             )
         self._K = K
-        self._kfill = min(K, _ceil_to(kmax, 8))
+        self._kfill = min(K, ceil_to(kmax, 8))
         dtype = self._dtype
         self._wants = self._put_rows(w.astype(dtype))
         self._has = self._put_rows(h.astype(dtype))
         self._sub = self._put_rows(s.astype(dtype))
         self._act = self._put_rows(act.astype(bool))
         self._uploaded_versions = versions
-        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
-        self._cap_raw = None
-        self._refresh_config(rows, self._config_epoch, self._clock())
-        self._rot_cursor = 0
+        self._config.reset(self._Rp)
+        self._fair_kinds = None
+        self._refresh_config(rows, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
 
-    def _rows_changed(self, resources: List[Resource]) -> bool:
+    def _needs_rebuild(self, resources: List[Resource]) -> bool:
         # Full identity scan every tick: a mid-list replacement with
         # matching endpoints must trigger a rebuild, and 10k `is`
         # comparisons cost well under a millisecond.
-        return len(resources) != self._R or any(
-            a is not b for a, b in zip(resources, self._rows)
+        return (
+            self._wants is None
+            or len(resources) != self._R
+            or any(a is not b for a, b in zip(resources, self._rows))
         )
 
-    def _rotation_rows(self) -> np.ndarray:
-        """This tick's rotation slice (advances the cursor state).
-        Single device: one cursor walks all R rows. Mesh: per-shard
-        cursors walk each shard's own real rows, so every tick's
-        delivery download stays balanced across shards instead of one
-        contiguous window marching through them."""
+    def _fair_rows(self):
+        """Device array of FAIR_SHARE row indices, padded to a bucketed
+        static shape (single device: [Fb]; mesh: per-shard [n_dev, Fb]
+        shard-local blocks). None when no row runs FAIR_SHARE. Rebuilt
+        when the config's kind vector object moves (epoch changes)."""
+        kind_h = self._config.kind_h
+        if kind_h is self._fair_kinds:
+            return self._fair_rows_d
+        self._fair_kinds = kind_h
+        fair = np.nonzero(
+            kind_h[: self._R] == int(AlgoKind.FAIR_SHARE)
+        )[0].astype(np.int64)
+        if not len(fair):
+            self._fair_rows_d = None
+            return None
         if self._meshrows is None:
-            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
-            rot = (
-                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
-            ) % max(self._R, 1)
-            self._rot_cursor = (
-                self._rot_cursor + rot_block
-            ) % max(self._R, 1)
-            return rot
-        return self._meshrows.rotation_rows(
-            self._rot_shard_cursors, self._R,
-            self._Rp // self._meshrows.n_dev, self.rotate_ticks,
+            Fb = ceil_to(len(fair), 8)
+            self._fair_rows_d = self._put(
+                np.resize(fair, Fb).astype(np.int32)
+            )
+            return self._fair_rows_d
+        from doorman_tpu.solver.resident_mesh import (
+            group_by_shard,
+            pad_shard_indices,
         )
+
+        n_dev = self._meshrows.n_dev
+        Rl = self._Rp // n_dev
+        owner = fair // Rl
+        counts, (loc,) = group_by_shard(owner, n_dev, [fair - owner * Rl])
+        Fb = ceil_to(int(counts.max()) if len(fair) else 1, 8)
+        blocks = pad_shard_indices(counts, Fb, loc)
+        self._fair_rows_d = self._put_rows(blocks.astype(np.int32))
+        return self._fair_rows_d
 
     # -- the tick executable ------------------------------------------
 
-    def _tick_fn_mesh(self, Da: int, Df: int, Sb: int):
+    def _tick_fn_mesh(self, Da: int, Df: int, Sb: int, lanes: frozenset):
         """The shard_mapped tick: tables row-sharded over the mesh,
         staged blocks pre-partitioned per shard (leading device axis),
         no collectives (rows are independent). Scatter indices are
         shard-LOCAL; padded scatter slots carry the out-of-range index
         Rl and drop, padded gather slots repeat a valid index and are
         sliced off at collect."""
-        key = (Da, Df, Sb, self._kfill)
+        key = (Da, Df, Sb, self._kfill, lanes)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -468,14 +291,13 @@ class ResidentDenseSolver:
         if use_pallas:
             from doorman_tpu.solver.pallas_dense import solve_dense_pallas
 
-            solve = solve_dense_pallas
-        else:
-            solve = solve_dense
         kfill = self._kfill
+        dtype = self._dtype
         out_dtype = self._out_dtype
         axes = self._meshrows.axes
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
 
-        def body(wants, has, sub, act, idx, a_w, f_block, f_act,
+        def body(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
                  cap, kind, learn, statc):
             # Per-shard staged blocks arrive as [1, ...]; tables and
             # per-row config as this shard's [Rl, ...] block.
@@ -483,17 +305,26 @@ class ResidentDenseSolver:
             a_idx = idx[:Da]
             f_idx = idx[Da:Da + Df]
             sel_idx = idx[Da + Df:]
-            wants = wants.at[a_idx, :kfill].set(a_w[0], mode="drop")
+            # Wants blocks may arrive bf16 (exact-round-trip compact
+            # upload); the cast back is the identity then.
+            wants = wants.at[a_idx, :kfill].set(
+                a_w[0].astype(dtype), mode="drop"
+            )
             has = has.at[f_idx, :kfill].set(f_block[0, 0], mode="drop")
             sub = sub.at[f_idx, :kfill].set(f_block[0, 1], mode="drop")
             act = act.at[f_idx, :kfill].set(f_act[0], mode="drop")
-            gets = solve(
-                DenseBatch(
-                    wants=wants, has=has, subclients=sub, active=act,
-                    capacity=cap, algo_kind=kind, learning=learn,
-                    static_capacity=statc,
-                )
+            batch = DenseBatch(
+                wants=wants, has=has, subclients=sub, active=act,
+                capacity=cap, algo_kind=kind, learning=learn,
+                static_capacity=statc,
             )
+            if use_pallas:
+                gets = solve_dense_pallas(batch)
+            else:
+                gets = solve_dense(
+                    batch, lanes=lanes,
+                    fair_rows=fair[0] if want_fair else None,
+                )
             out = jnp.take(
                 gets, sel_idx, axis=0, mode="clip",
                 indices_are_sorted=True,
@@ -512,6 +343,7 @@ class ResidentDenseSolver:
                 dev2,  # a_w [n_dev, Da, kfill]
                 P(axes, None, None, None),  # f_block [n_dev, 2, Df, kfill]
                 dev2,  # f_act [n_dev, Df, kfill]
+                rowk,  # fair rows [n_dev, Fb] (shard-local)
                 row, row, row, row,  # per-row config
             ),
             out_specs=(rowk, rowk, rowk, rowk, dev2),
@@ -524,8 +356,8 @@ class ResidentDenseSolver:
         self._tick_fns[key] = tick
         return tick
 
-    def _tick_fn(self, Da: int, Df: int, Sb: int):
-        key = (Da, Df, Sb, self._kfill)
+    def _tick_fn(self, Da: int, Df: int, Sb: int, lanes: frozenset):
+        key = (Da, Df, Sb, self._kfill, lanes)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -543,11 +375,10 @@ class ResidentDenseSolver:
         if use_pallas:
             from doorman_tpu.solver.pallas_dense import solve_dense_pallas
 
-            solve = solve_dense_pallas
-        else:
-            solve = solve_dense
         kfill = self._kfill
+        dtype = self._dtype
         out_dtype = self._out_dtype
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
 
         # Scatters touch only the first `kfill` lanes: the table is
         # zeroed beyond every row's count at rebuild and `kfill` never
@@ -558,22 +389,28 @@ class ResidentDenseSolver:
         # carries all three index sets — the tunnel link charges per
         # transfer op, not just per byte.
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def tick(wants, has, sub, act, idx, a_w, f_block, f_act,
+        def tick(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
                  cap, kind, learn, statc):
             a_idx = idx[:Da]
             f_idx = idx[Da:Da + Df]
             sel_idx = idx[Da + Df:]
-            wants = wants.at[a_idx, :kfill].set(a_w)
+            # a_w may arrive bf16 (compact upload): cast is identity.
+            wants = wants.at[a_idx, :kfill].set(a_w.astype(dtype))
             has = has.at[f_idx, :kfill].set(f_block[0])
             sub = sub.at[f_idx, :kfill].set(f_block[1])
             act = act.at[f_idx, :kfill].set(f_act)
-            gets = solve(
-                DenseBatch(
-                    wants=wants, has=has, subclients=sub, active=act,
-                    capacity=cap, algo_kind=kind, learning=learn,
-                    static_capacity=statc,
-                )
+            batch = DenseBatch(
+                wants=wants, has=has, subclients=sub, active=act,
+                capacity=cap, algo_kind=kind, learning=learn,
+                static_capacity=statc,
             )
+            if use_pallas:
+                gets = solve_dense_pallas(batch)
+            else:
+                gets = solve_dense(
+                    batch, lanes=lanes,
+                    fair_rows=fair if want_fair else None,
+                )
             # `gets` IS the next tick's has: grants chain on device
             # (learning rows replay has, so the chain preserves them;
             # inactive lanes solve to 0).
@@ -585,75 +422,117 @@ class ResidentDenseSolver:
 
     # -- phases -------------------------------------------------------
 
-    def dispatch(
-        self, resources: Sequence[Resource], config_epoch: int = 0
-    ) -> TickHandle:
-        """Host+device phase: sweep expiries, upload dirty rows, launch
-        the solve, and start the grant download for this tick's
-        deliverable rows. Safe to run in an executor thread.
-
-        `config_epoch`: bump whenever templates / learning windows /
-        parent leases changed outside the store (config reload,
-        mastership change) — template reads are cached against it."""
-        ph = PhaseRecorder("resident", self.phase_s)
-        lap = ph.lap
-
-        now = self._clock()
-        self._engine.clean_all(now)
-        lap("sweep")
-        res_list = list(resources)
-        if self._wants is None or self._rows_changed(res_list):
-            self.rebuild(res_list)
-            lap("rebuild")  # rebuilds are rare; timed as their own phase
-
+    def _drain(self, ph: PhaseRecorder):
+        """Drain the engine's dirty-row flags and resolve them to table
+        rows; also consumes the admission-fused pack cache (the drained
+        set stays authoritative for WHICH rows ship)."""
         dirty_rids, full_flags = self._engine.drain_dirty2()
         if len(dirty_rids):
             lut = self._row_lut
-            rows_all = lut[np.minimum(dirty_rids, len(lut) - 1)]
+            clamped = np.minimum(dirty_rids, len(lut) - 1)
+            rows_all = lut[clamped]
+            oob = dirty_rids != clamped
+            if oob.any():
+                # Rids above the LUT are resources registered after the
+                # last rebuild (wide/priority rows sharing the engine);
+                # they must resolve to "not ours" through the reserved
+                # trailing -1 slot. A clamped rid landing on a REAL row
+                # would silently misattribute another resource's writes
+                # to our last row — loud, never silent.
+                aliased = rows_all[oob] >= 0
+                if aliased.any():
+                    detail = {
+                        "oob_rids": np.asarray(dirty_rids[oob][:8]).tolist(),
+                        "lut_size": int(len(lut)),
+                        "aliased_rows": np.asarray(
+                            rows_all[oob][aliased][:8]
+                        ).tolist(),
+                    }
+                    self._anomaly("dirty_rid_alias", detail)
+                    raise AssertionError(
+                        "resident row LUT reserved slot is not -1: "
+                        f"out-of-range rids would alias live rows {detail}"
+                    )
             valid = rows_all >= 0
             dirty_rows = rows_all[valid]
             dirty_full = full_flags[valid].astype(bool)
         else:
             dirty_rows = np.zeros(0, np.int64)
             dirty_full = np.zeros(0, bool)
-        lap("drain")
-        config_changed = self._refresh_config(res_list, config_epoch, now)
-        lap("config")
-
-        # Idle fast path: with no store changes and no config movement
-        # for TWO full rotations, the store of record provably holds the
-        # device fixpoint, and an idle server then costs NO device work
-        # per tick instead of a full solve + delivery forever. Two
-        # rotations, not one: the `has` chain is an iteration — a row
-        # delivered early in the FIRST quiet rotation can carry a
-        # pre-convergence value (proportional lanes redistribute freed
-        # capacity over ~2 ticks) — while every delivery in the second
-        # rotation is at least a full rotation of iterations past the
-        # last change, far beyond any lane's convergence depth. Any
-        # store write, expiry sweep removal (it dirties the row), config
-        # epoch bump, or time-driven capacity/learning flip resumes real
-        # ticks on the very next dispatch.
-        quiet = (
-            len(dirty_rows) == 0
-            and not self._just_rebuilt
-            and config_changed is not None
-            and len(config_changed) == 0
-        )
-        if quiet:
-            self._quiet_ticks += 1
-            if self._quiet_ticks > max(2 * self.rotate_ticks,
-                                       self.rotate_ticks + 3):
-                return TickHandle(
-                    out=None,
-                    sel_rows=np.zeros(0, np.int64),
-                    rids=np.zeros(0, np.int32),
-                    versions=np.zeros(0, np.uint64),
-                    keep_has=np.zeros(0, np.uint8),
-                    n_sel=0,
-                    dispatched_at=now,
-                )
+        if self._staging is not None:
+            fused, fwin, frows = self._staging.take()
         else:
-            self._quiet_ticks = 0
+            fused, fwin, frows = None, 0, 0
+        ph.lap("drain")
+        return dirty_rows, dirty_full, fused, fwin, frows
+
+    def _drained_empty(self, drained) -> bool:
+        return len(drained[0]) == 0
+
+    def _pack_rows_fused(self, pack_rids: np.ndarray, kfill: int, fused):
+        """Pack the given rids at lane width kfill, serving rows from
+        the window-time pack cache where a valid entry exists (same
+        kfill; see FusedStaging for the freshness contract) and one C
+        pack call for the rest. Returns (w, h, s, act, counts,
+        versions, rows_hit)."""
+        n = len(pack_rids)
+        if not fused:
+            w, h, s, act, counts, versions = self._engine.pack_rows(
+                pack_rids, kfill
+            )
+            return w, h, s, act, counts, versions, 0
+        hit = np.zeros(n, bool)
+        entries = []
+        for i, rid in enumerate(pack_rids):
+            e = fused.get(int(rid))
+            if e is not None and e[0] == kfill:
+                hit[i] = True
+                entries.append(e)
+        if not hit.any():
+            w, h, s, act, counts, versions = self._engine.pack_rows(
+                pack_rids, kfill
+            )
+            return w, h, s, act, counts, versions, 0
+        w = np.zeros((n, kfill), np.float64)
+        h = np.zeros((n, kfill), np.float64)
+        s = np.zeros((n, kfill), np.float64)
+        act = np.zeros((n, kfill), np.uint8)
+        counts = np.zeros(n, np.int32)
+        versions = np.zeros(n, np.uint64)
+        miss = ~hit
+        if miss.any():
+            mw, mh, ms, mact, mcounts, mversions = self._engine.pack_rows(
+                pack_rids[miss], kfill
+            )
+            w[miss] = mw
+            h[miss] = mh
+            s[miss] = ms
+            act[miss] = mact
+            counts[miss] = mcounts
+            versions[miss] = mversions
+        # One stacked assignment per field (hundreds of cached rows per
+        # tick at the bench shape; a per-row loop here would eat the
+        # pack time the cache is saving).
+        hit_pos = np.nonzero(hit)[0]
+        w[hit_pos] = np.stack([e[1] for e in entries])
+        h[hit_pos] = np.stack([e[2] for e in entries])
+        s[hit_pos] = np.stack([e[3] for e in entries])
+        act[hit_pos] = np.stack([e[4] for e in entries])
+        counts[hit_pos] = [e[5] for e in entries]
+        versions[hit_pos] = [e[6] for e in entries]
+        return w, h, s, act, counts, versions, int(hit.sum())
+
+    def stage_rids(self, rids) -> int:
+        """Admission-window entry point: pack the given engine rids into
+        the fused staging cache at the current lane width (no-op without
+        attached staging). Called from the coalescer's grouped pass (or
+        the bench's synthetic windows) right after the store writes."""
+        if self._staging is None:
+            return 0
+        return self._staging.stage(rids, self._kfill)
+
+    def _launch(self, res_list, drained, config_changed, now, ph):
+        dirty_rows, dirty_full, fused, fwin, frows = drained
         if len(dirty_rows) == 0:
             # No demand changes: scatter the reserved zero padding row.
             dirty_rows = np.asarray([self._R], np.int64)
@@ -665,21 +544,25 @@ class ResidentDenseSolver:
         )
         n_full = int(dirty_full.sum())
         pack_rids = self._rids[order]
+        rows_hit = 0
         while True:
-            w, h, s, act, counts, versions = self._engine.pack_rows(
-                pack_rids, self._kfill
+            w, h, s, act, counts, versions, rows_hit = (
+                self._pack_rows_fused(pack_rids, self._kfill, fused)
             )
             kmax = int(counts.max()) if len(counts) else 0
             if kmax <= self._kfill:
                 break
-            if _ceil_to(kmax, 8) > self._K:
+            if ceil_to(kmax, 8) > self._K:
                 # Bucket overflow: a resource outgrew the lane width.
                 self.rebuild(res_list)
                 order = np.asarray([self._R], np.int64)
                 n_full = 0
                 pack_rids = self._rids[order]
+                fused = None
             else:
-                self._kfill = min(self._K, _ceil_to(kmax, 8))
+                # Lane width grows: cached packs (old kfill) no longer
+                # fit and are repacked through the miss path.
+                self._kfill = min(self._K, ceil_to(kmax, 8))
         # Rows whose membership epoch moved between the drain and the
         # pack are promoted to full uploads: their packed slot order no
         # longer matches the device tables' act/sub/has lanes.
@@ -687,7 +570,7 @@ class ResidentDenseSolver:
         is_full[:n_full] = True
         is_full |= versions != self._uploaded_versions[order]
         self._uploaded_versions[order] = versions
-        lap("pack")
+        ph.lap("pack")
 
         # Delivery set: every dirty row + every config-changed row + the
         # rotation slice — or every row on a rebuild/epoch-moved tick
@@ -700,7 +583,12 @@ class ResidentDenseSolver:
             self._just_rebuilt = False
             sel = np.arange(max(self._R, 1), dtype=np.int64)
         else:
-            rot = self._rotation_rows()
+            rot = self._rotation_rows(
+                self._R,
+                self._Rp // self._meshrows.n_dev
+                if self._meshrows is not None
+                else 0,
+            )
             parts = [order, rot]
             if len(config_changed):
                 # Config rows at/above _R are padding; never deliver them.
@@ -710,17 +598,23 @@ class ResidentDenseSolver:
 
         if self._meshrows is not None:
             return self._stage_mesh(
-                order, is_full, w, h, s, act, sel, now, ph
+                order, is_full, w, h, s, act, sel, now, ph, fwin, rows_hit
             )
 
         kfill = self._kfill
         dtype = self._dtype
-        Da = _ceil_to(len(order), 64)
-        Df = _ceil_to(int(is_full.sum()), 8)
-        Sb = _ceil_to(n_sel, 256)
+        Da = ceil_to(len(order), 64)
+        Df = ceil_to(int(is_full.sum()), 8)
+        Sb = ceil_to(n_sel, 256)
         a_pad = np.resize(np.arange(len(order)), Da)
         a_idx = order[a_pad]
         a_w = np.ascontiguousarray(w[a_pad, :kfill]).astype(dtype)
+        # Compact upload: the wants-only block (the steady-state bulk of
+        # the upload bytes) ships as bf16 when the values round-trip
+        # exactly — byte-identical, half (f32) to a quarter (f64) of the
+        # bytes. Checked per tick on the host; the executable casts back.
+        if _BF16 is not None and bf16_exact(a_w):
+            a_w = a_w.astype(_BF16)
         f_pos = np.nonzero(is_full)[0]
         if len(f_pos):
             f_pad = np.resize(f_pos, Df)
@@ -737,18 +631,24 @@ class ResidentDenseSolver:
             f_act = np.zeros((Df, kfill), bool)
         sel_pad = np.resize(sel, Sb)
         idx_host = np.concatenate([a_idx, f_idx, sel_pad]).astype(np.int32)
+        lanes = self._config.lanes()
+        fair_d = self._fair_rows()
+        ph.lap("staging")
 
         put = self._put
-        tick = self._tick_fn(Da, Df, Sb)
+        tick = self._tick_fn(Da, Df, Sb, lanes)
+        if fair_d is None:
+            fair_d = put(np.zeros(8, np.int32))
         staged = (put(idx_host), put(a_w), put(f_block), put(f_act))
-        lap("upload")
+        ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_act_d = staged
+        cfg = self._config
         (
             self._wants, self._has, self._sub, self._act, out
         ) = tick(
             self._wants, self._has, self._sub, self._act,
-            idx_d, a_w_d, f_block_d, f_act_d,
-            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+            idx_d, a_w_d, f_block_d, f_act_d, fair_d,
+            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
         )
         # Start the grant download as SEVERAL async streams: the
         # tunneled device link only reaches full bandwidth with
@@ -763,19 +663,23 @@ class ResidentDenseSolver:
         # backend this is the synchronous device solve; on TPU it is
         # the (async) launch of it — the device-side time shows in the
         # JAX profiler capture, not here.
-        lap("solve")
+        ph.lap("solve")
+        self.last_fused = {"windows": fwin, "rows": rows_hit}
         return TickHandle(
             out=out,
             sel_rows=sel,
             rids=self._rids[sel],
             versions=self._uploaded_versions[sel],
-            keep_has=self._learn_h[sel].astype(np.uint8),
+            keep_has=cfg.learn_h[sel].astype(np.uint8),
             n_sel=n_sel,
             dispatched_at=now,
+            fused_windows=fwin,
+            fused_rows=rows_hit,
         )
 
-    def _stage_mesh(self, order, is_full, w, h, s, act, sel, now, ph):
-        """Mesh tail of dispatch(): group this tick's row scatters and
+    def _stage_mesh(self, order, is_full, w, h, s, act, sel, now, ph,
+                    fwin=0, rows_hit=0):
+        """Mesh tail of the launch: group this tick's row scatters and
         the delivery set by owning shard, stage per-shard blocks (the
         sharded device_put moves only each shard's slice onto its
         device — a dirty row's upload reaches the owning shard and no
@@ -817,13 +721,17 @@ class ResidentDenseSolver:
             owner_sel, n_dev, [sel - owner_sel * Rl]
         )
 
-        Da = _ceil_to(int(counts_a.max()), 64)
-        Df = _ceil_to(int(counts_f.max()) if len(f_pos) else 1, 8)
-        Sb = _ceil_to(int(counts_sel.max()), 256)
+        Da = ceil_to(int(counts_a.max()), 64)
+        Df = ceil_to(int(counts_f.max()) if len(f_pos) else 1, 8)
+        Sb = ceil_to(int(counts_sel.max()), 256)
         a_idx_b, a_w_b = pad_shard_blocks(
             counts_a, Da,
             [(a_idx_l, Rl), (a_w_l.astype(dtype), 0)],
         )
+        # Compact upload of the wants blocks (see the single-device
+        # tail): bf16 when the round trip is exact.
+        if _BF16 is not None and bf16_exact(a_w_b):
+            a_w_b = a_w_b.astype(_BF16)
         f_idx_b, f_h_b, f_s_b, f_a_b = pad_shard_blocks(
             counts_f, Df,
             [
@@ -836,6 +744,9 @@ class ResidentDenseSolver:
         idx_host = np.concatenate(
             [a_idx_b, f_idx_b, sel_b], axis=1
         ).astype(np.int32)
+        lanes = self._config.lanes()
+        fair_d = self._fair_rows()
+        ph.lap("staging")
 
         itemsize = dtype.itemsize
         ph.shard_bytes(
@@ -849,63 +760,40 @@ class ResidentDenseSolver:
             counts_sel * kfill * np.dtype(self._out_dtype).itemsize,
         )
         put = self._put_rows
-        tick = self._tick_fn_mesh(Da, Df, Sb)
+        tick = self._tick_fn_mesh(Da, Df, Sb, lanes)
+        if fair_d is None:
+            fair_d = put(np.zeros((n_dev, 8), np.int32))
         staged = (put(idx_host), put(a_w_b), put(f_block), put(f_a_b))
         ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_a_d = staged
+        cfg = self._config
         (
             self._wants, self._has, self._sub, self._act, out
         ) = tick(
             self._wants, self._has, self._sub, self._act,
-            idx_d, a_w_d, f_block_d, f_a_d,
-            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+            idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
         )
         out = start_sharded_download(out)
         ph.lap("solve")
+        self.last_fused = {"windows": fwin, "rows": rows_hit}
         return TickHandle(
             out=out,
             sel_rows=sel,
             rids=self._rids[sel],
             versions=self._uploaded_versions[sel],
-            keep_has=self._learn_h[sel].astype(np.uint8),
+            keep_has=cfg.learn_h[sel].astype(np.uint8),
             n_sel=n_sel,
             dispatched_at=now,
             shard_counts=counts_sel,
+            fused_windows=fwin,
+            fused_rows=rows_hit,
         )
 
-    def collect(self, handle: TickHandle) -> int:
-        """Write one tick's downloaded grants back into the engine; rows
-        whose membership moved mid-flight are skipped (they re-deliver
-        next tick). Returns the rows applied."""
-        if handle.collected:
-            return 0
-        handle.collected = True
-        if handle.out is None:
-            # Idle tick: the store already holds the fixpoint; this
-            # still counts as an applied tick (the table is current).
-            self.ticks += 1
-            self.idle_ticks += 1
-            self.last_tick_seconds = self._clock() - handle.dispatched_at
-            return 0
-        ph = PhaseRecorder("resident", self.phase_s)
-        # Parts were split (and their async copies started) at
-        # dispatch; land them in order into one buffer.
-        gets = landed_rows(handle)
-        ph.lap("download")
-        applied = self._engine.apply_dense(
+    def _apply_grants(self, handle: TickHandle, gets: np.ndarray) -> int:
+        return self._engine.apply_dense(
             handle.rids,
             gets,
             handle.keep_has,
             handle.versions,
         )
-        ph.lap("apply")
-        self.ticks += 1
-        self.last_tick_seconds = self._clock() - handle.dispatched_at
-        return applied
-
-    def step(
-        self, resources: Sequence[Resource], config_epoch: int = 0
-    ) -> int:
-        """Sequential convenience: dispatch a tick and collect it
-        immediately (the pipelined callers keep their own handle queue)."""
-        return self.collect(self.dispatch(resources, config_epoch))

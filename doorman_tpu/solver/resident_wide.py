@@ -14,14 +14,21 @@ in solver.dense.solve_chunked.
 What crosses the host<->device link per tick (the link is the tick's
 bottleneck at 1M leases, and the whole point of this layout):
 
-  upload:   individual dirty SLOTS as one flat 1D scatter (the engine
+  staging:  individual dirty SLOTS as one flat 1D scatter (the engine
             tracks dirtiness per slot for chunk-tracked resources, so a
             single client's wants change ships 8 bytes, not a
             million-lease table). Wants-only churn ships just the wants
             value; slots whose shape changed (membership, has,
-            subclients) ship all four lanes.
-  solve:    the full table every tick; `has` chains on device.
-  download: chunk rows being DELIVERED this tick: rows containing
+            subclients) ship all four lanes. Flat slot indices ship as
+            int32 when the table fits (engine.compact_index_dtype —
+            half the index bytes), and the wants-value block ships bf16
+            when that round-trips exactly (engine.bf16_exact).
+  solve:    the full table every tick; `has` chains on device. Absent
+            algorithm lanes are skipped via the config mirror's static
+            lane mask (solver.lanes — byte-identical by construction;
+            the chunked layout keeps the full-table water-fill when a
+            FAIR_SHARE segment exists, since a segment spans rows).
+  delivery: chunk rows being DELIVERED this tick: rows containing
             full-dirty slots (membership / client-reported has — these
             must land in the store promptly), every row of a resource
             whose effective config changed (same-tick config freshness,
@@ -47,8 +54,9 @@ expected version can lag the device state but never lead it — a
 mid-flight membership change makes the apply skip that chunk and the
 re-marked slots re-deliver it next tick.
 
-Same dispatch/collect/step surface as ResidentDenseSolver; the server
-runs one of each when a config mixes narrow and wide resources.
+The stage skeleton and shared chokepoints live in solver/engine.py
+(same contract as ResidentDenseSolver); the server runs one of each
+when a config mixes narrow and wide resources.
 """
 
 from __future__ import annotations
@@ -58,19 +66,22 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
+from doorman_tpu.core.resource import Resource
 from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.solver.batch import DENSE_MAX_K, _round_rows
-from doorman_tpu.solver.resident import (
+from doorman_tpu.solver.engine import (
+    TickEngineBase,
     TickHandle,
-    _ceil_to,
-    landed_rows,
-    place,
+    bf16_exact,
+    ceil_to,
+    compact_index_dtype,
 )
+from doorman_tpu.solver.engine import _BF16
+from doorman_tpu.solver.resident import _ceil_to  # noqa: F401 (compat)
 
 
-class WideResidentSolver:
+class WideResidentSolver(TickEngineBase):
     """Steady-state batched ticks for resources wider than the dense
     bucket cap, with the device as the table of record.
 
@@ -78,6 +89,8 @@ class WideResidentSolver:
     the caller partitions: narrow lane resources -> ResidentDenseSolver,
     PRIORITY_BANDS -> BatchSolver priority part, wide lane -> here.
     """
+
+    component = "resident_wide"
 
     def __init__(
         self,
@@ -92,55 +105,24 @@ class WideResidentSolver:
         download_dtype=None,
         chunk_width: "int | None" = None,
     ):
-        import jax
-
-        if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
-            raise RuntimeError(
-                "WideResidentSolver dtype=float64 requires jax_enable_x64"
-            )
-        self._engine = engine
-        self._dtype = np.dtype(dtype)
-        self._device = device
-        # A parallel.mesh Mesh shards the chunk-row axis across every
-        # mesh axis. Wide resources' chunks SPAN shards, so the
-        # shard_mapped tick combines per-segment totals over ICI with
-        # the bit-stable psum reduction in
-        # parallel.sharded.resident_chunk_reduces — grants (and thus
-        # store contents) stay byte-identical to the single-device
-        # tick. `device` is ignored under a mesh.
-        self._mesh = mesh
-        self._meshrows = None
-        if mesh is not None:
-            from doorman_tpu.solver.resident_mesh import MeshRows
-
-            self._meshrows = MeshRows(mesh)
-        self._rot_shard_cursors: "np.ndarray | None" = None
-        self._clock = clock
+        super().__init__(
+            engine,
+            dtype=dtype,
+            device=device,
+            mesh=mesh,
+            clock=clock,
+            rotate_ticks=rotate_ticks,
+            tick_interval=tick_interval,
+            download_dtype=download_dtype,
+            config_put=self._put_rep,
+        )
         self._W = int(chunk_width or DENSE_MAX_K)
-        self._tick_interval = tick_interval
-        self._rotate_override: "int | None" = None
-        if rotate_ticks is None:
-            self._rotate = 8
-        else:
-            self.rotate_ticks = rotate_ticks
-        self._out_dtype = download_dtype or self._dtype
-        self.ticks = 0
-        self.idle_ticks = 0
-        self.last_tick_seconds = 0.0
-        self._quiet_ticks = 0
-        self.phase_s: Dict[str, float] = {
-            name: 0.0
-            for name in (
-                "sweep", "drain", "config", "pack", "upload", "solve",
-                "download", "apply", "rebuild",
-            )
-        }
-
         self._res: List[Resource] = []
         self._S = 0  # segments (resources)
         self._Sp = 8
         self._R = 0  # real chunk rows
         self._Rp = 0  # padded rows
+        self._idx_dtype = np.int64  # flat slot indices (compacted at rebuild)
         self._base_row = np.zeros(0, np.int64)  # per segment
         self._n_chunks = np.zeros(0, np.int64)  # per segment
         self._row_rids = np.zeros(0, np.int32)  # per row (-1 padding)
@@ -151,113 +133,6 @@ class WideResidentSolver:
         # Device tables (donated through each tick executable).
         self._wants = self._has = self._sub = self._act = None
         self._row_seg_d = None
-        # Per-segment config, host mirror + device handle.
-        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
-        self._cap_d = self._kind_d = self._statc_d = self._learn_d = None
-        self._refresh = None
-        self._cap_raw = self._learn_end = self._parent_exp = None
-        self._config_epoch = -1
-        self._rot_cursor = 0
-        self._just_rebuilt = False
-
-        self._tick_fns: Dict[Tuple[int, int, int], Callable] = {}
-
-    # -- configuration ------------------------------------------------
-
-    @property
-    def rotate_ticks(self) -> int:
-        return self._rotate
-
-    @rotate_ticks.setter
-    def rotate_ticks(self, value: int) -> None:
-        self._rotate_override = max(int(value), 1)
-        self._rotate = self._rotate_override
-
-    def _put(self, arr, sharding=None):
-        return place(arr, device=self._device, sharding=sharding)
-
-    def _put_rows(self, arr):
-        """Row-axis placement: chunk-row tables and row_seg split over
-        the mesh, per-shard staged blocks split by their leading device
-        axis. Single-device put without a mesh."""
-        if self._meshrows is None:
-            return self._put(arr)
-        return self._put(arr, self._meshrows.shard0(np.ndim(arr)))
-
-    def _put_rep(self, arr):
-        """Per-SEGMENT config vectors: replicated on every mesh device
-        (each shard's solve reads all segment config)."""
-        if self._meshrows is None:
-            return self._put(arr)
-        return self._put(arr, self._meshrows.replicated())
-
-    # -- config tracking (per SEGMENT; the narrow solver's per-row
-    # equivalents are resident.py:194-274 — same cadence rules) --------
-
-    def _read_config(self, res: Sequence[Resource]) -> None:
-        Sp = self._Sp
-        dtype = self._dtype
-        cap = np.zeros(Sp, dtype)
-        kind = np.zeros(Sp, np.int32)
-        statc = np.zeros(Sp, dtype)
-        refresh = np.full(Sp, 1.0, np.float64)
-        learn_end = np.zeros(Sp, np.float64)
-        parent_exp = np.full(Sp, np.inf, np.float64)
-        for i, r in enumerate(res):
-            tpl = r.template
-            cap[i] = tpl.capacity
-            kind[i] = algo_kind_for(tpl)
-            statc[i] = static_param(tpl)
-            refresh[i] = float(tpl.algorithm.refresh_interval)
-            learn_end[i] = r.learning_mode_end
-            if r.parent_expiry is not None:
-                parent_exp[i] = r.parent_expiry
-        self._cap_raw = cap
-        self._learn_end = learn_end
-        self._parent_exp = parent_exp
-        self._refresh = refresh
-        if self._rotate_override is None and self._tick_interval and res:
-            # Delivery covers the table at least once per refresh
-            # interval (capped at 64 — see resident.py:219-235).
-            self._rotate = max(
-                1,
-                min(
-                    int(refresh[: len(res)].min() / self._tick_interval),
-                    64,
-                ),
-            )
-        if self._kind_h is None or not np.array_equal(kind, self._kind_h):
-            self._kind_h, self._kind_d = kind, self._put_rep(kind)
-        if self._statc_h is None or not np.array_equal(statc, self._statc_h):
-            self._statc_h, self._statc_d = statc, self._put_rep(statc)
-
-    def _refresh_config(
-        self, res: Sequence[Resource], config_epoch: int, now: float
-    ) -> "np.ndarray | None":
-        """Per-tick config view; returns SEGMENTS whose effective config
-        changed this tick (their rows must all deliver this tick), or
-        None for "everything may have changed" (epoch move / first
-        tick). Same semantics as resident.py:241-274."""
-        epoch_moved = (
-            config_epoch != self._config_epoch or self._cap_raw is None
-        )
-        if epoch_moved:
-            self._config_epoch = config_epoch
-            self._read_config(res)
-        cap = np.where(
-            self._parent_exp < now, 0.0, self._cap_raw
-        ).astype(self._dtype)
-        learn = self._learn_end > now
-        if epoch_moved or self._cap_h is None or self._learn_h is None:
-            changed: "np.ndarray | None" = None
-        else:
-            mask = (cap != self._cap_h) | (learn != self._learn_h)
-            changed = np.nonzero(mask)[0]
-        if self._cap_h is None or not np.array_equal(cap, self._cap_h):
-            self._cap_h, self._cap_d = cap, self._put_rep(cap)
-        if self._learn_h is None or not np.array_equal(learn, self._learn_h):
-            self._learn_h, self._learn_d = learn, self._put_rep(learn)
-        return changed
 
     # -- build / rebuild ----------------------------------------------
 
@@ -280,9 +155,12 @@ class WideResidentSolver:
             # Equal chunk-row blocks per shard; fresh per-shard
             # rotation cursors (the old ones indexed the old layout).
             self._Rp = self._meshrows.round_rows(self._Rp)
-            self._rot_shard_cursors = np.zeros(
-                self._meshrows.n_dev, np.int64
-            )
+            self._rotation.reset(self._meshrows.n_dev)
+        else:
+            self._rotation.reset()
+        # Flat device indices (slot s of the segment at row b lives at
+        # b*W + s): int32 halves the index-upload bytes when it fits.
+        self._idx_dtype = compact_index_dtype((self._Rp + 1) * W)
         self._row_rids = np.full(self._Rp, -1, np.int32)
         self._row_chunk = np.full(self._Rp, -1, np.int32)
         # Padding rows resolve to the reserved padding segment Sp-1
@@ -317,15 +195,13 @@ class WideResidentSolver:
         self._sub = self._put_rows(np.pad(s, pad).astype(dtype))
         self._act = self._put_rows(np.pad(act, pad).astype(bool))
         self._row_seg_d = self._put_rows(self._row_seg_h)
-        self._cap_h = self._learn_h = self._kind_h = self._statc_h = None
-        self._cap_raw = None
-        self._refresh_config(res, self._config_epoch, self._clock())
-        self._rot_cursor = 0
+        self._config.reset(self._Sp)
+        self._refresh_config(res, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
 
     def _needs_rebuild(self, resources: List[Resource]) -> bool:
-        if len(resources) != self._S or any(
+        if self._wants is None or len(resources) != self._S or any(
             a is not b for a, b in zip(resources, self._res)
         ):
             return True
@@ -336,27 +212,9 @@ class WideResidentSolver:
                 return True
         return False
 
-    def _rotation_rows(self) -> np.ndarray:
-        """This tick's rotation slice (advances the cursor state); the
-        mesh path rotates per shard so each tick's delivery download is
-        balanced across shards (see ResidentDenseSolver._rotation_rows)."""
-        if self._meshrows is None:
-            rot_block = -(-self._R // self.rotate_ticks) if self._R else 1
-            rot = (
-                self._rot_cursor + np.arange(rot_block, dtype=np.int64)
-            ) % max(self._R, 1)
-            self._rot_cursor = (
-                self._rot_cursor + rot_block
-            ) % max(self._R, 1)
-            return rot
-        return self._meshrows.rotation_rows(
-            self._rot_shard_cursors, self._R,
-            self._Rp // self._meshrows.n_dev, self.rotate_ticks,
-        )
-
     # -- the tick executable ------------------------------------------
 
-    def _tick_fn_mesh(self, Dw: int, Df: int, Sb: int):
+    def _tick_fn_mesh(self, Dw: int, Df: int, Sb: int, lanes: frozenset):
         """The shard_mapped chunked tick: tables and row_seg row-sharded
         over the mesh, per-segment config replicated, staged slot
         scatters pre-partitioned per shard (shard-LOCAL flat indices;
@@ -365,7 +223,7 @@ class WideResidentSolver:
         (parallel.sharded.resident_chunk_reduces), so a resource whose
         chunks straddle a shard boundary reduces to byte-identical
         totals vs the single-device solve_chunked."""
-        key = (Dw, Df, Sb)
+        key = (Dw, Df, Sb, lanes)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -384,6 +242,7 @@ class WideResidentSolver:
         axes = mr.axes
         Rp, W = self._Rp, self._W
         Rl = Rp // mr.n_dev
+        dtype = self._dtype
         out_dtype = self._out_dtype
         # The full row->segment map is a compile-time constant of this
         # executable (rebuilds clear _tick_fns): every shard runs the
@@ -398,7 +257,7 @@ class WideResidentSolver:
             f_idx = f_idx[0]
             wants = (
                 wants.reshape(-1)
-                .at[w_idx].set(w_val[0], mode="drop")
+                .at[w_idx].set(w_val[0].astype(dtype), mode="drop")
                 .at[f_idx].set(f_w[0], mode="drop")
                 .reshape(Rl, W)
             )
@@ -418,6 +277,7 @@ class WideResidentSolver:
                 wants, has, sub, act, cap, kind, learn, statc,
                 segsum=segsum, segmax=segmax,
                 expand=lambda totals: totals[row_seg][:, None],
+                lanes=lanes,
             )
             out = jnp.take(
                 gets, sel_idx[0], axis=0, mode="clip",
@@ -450,8 +310,8 @@ class WideResidentSolver:
         self._tick_fns[key] = tick
         return tick
 
-    def _tick_fn(self, Dw: int, Df: int, Sb: int):
-        key = (Dw, Df, Sb)
+    def _tick_fn(self, Dw: int, Df: int, Sb: int, lanes: frozenset):
+        key = (Dw, Df, Sb, lanes)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -465,6 +325,7 @@ class WideResidentSolver:
         )
 
         Rp, W = self._Rp, self._W
+        dtype = self._dtype
         out_dtype = self._out_dtype
         row_seg = self._row_seg_d
 
@@ -478,7 +339,7 @@ class WideResidentSolver:
                  f_s, f_a, sel_idx, cap, kind, learn, statc):
             wants = (
                 wants.reshape(-1)
-                .at[w_idx].set(w_val)
+                .at[w_idx].set(w_val.astype(dtype))
                 .at[f_idx].set(f_w)
                 .reshape(Rp, W)
             )
@@ -490,7 +351,8 @@ class WideResidentSolver:
                     wants=wants, has=has, subclients=sub, active=act,
                     row_seg=row_seg, capacity=cap, algo_kind=kind,
                     learning=learn, static_capacity=statc,
-                )
+                ),
+                lanes=lanes,
             )
             out = gets[sel_idx, :].astype(out_dtype)
             return wants, gets, sub, act, out
@@ -500,25 +362,9 @@ class WideResidentSolver:
 
     # -- phases -------------------------------------------------------
 
-    def dispatch(
-        self, resources: Sequence[Resource], config_epoch: int = 0
-    ) -> TickHandle:
-        """Host+device phase: sweep, drain dirty slots, upload the
-        deltas, launch the solve, start the delivery download. Safe to
-        run in an executor thread (the engine is mutex-guarded)."""
-        ph = PhaseRecorder("resident_wide", self.phase_s)
-        lap = ph.lap
-
-        now = self._clock()
-        self._engine.clean_all(now)
-        lap("sweep")
-        res_list = list(resources)
-        if self._wants is None or self._needs_rebuild(res_list):
-            self.rebuild(res_list)
-            lap("rebuild")
-
-        # Drain dirty slots of our tracked rids. (drain FIRST, then
-        # read versions, then pack — see StoreEngine.chunk_versions.)
+    def _drain(self, ph: PhaseRecorder):
+        """Drain dirty slots of our tracked rids. (drain FIRST, then
+        read versions, then pack — see StoreEngine.chunk_versions.)"""
         W = self._W
         slot_parts: List[np.ndarray] = []  # flat device indices
         lvl_parts: List[np.ndarray] = []
@@ -552,33 +398,14 @@ class WideResidentSolver:
             levels = np.zeros(0, np.uint8)
             slot_rids = np.zeros(0, np.int32)
             raw_slots = np.zeros(0, np.int64)
-        lap("drain")
-        config_changed = self._refresh_config(res_list, config_epoch, now)
-        lap("config")
+        ph.lap("drain")
+        return flat_idx, levels, slot_rids, raw_slots
 
-        # Idle fast path: same two-rotation rule as the narrow solver
-        # (resident.py:454-484 documents why two).
-        quiet = (
-            len(flat_idx) == 0
-            and not self._just_rebuilt
-            and config_changed is not None
-            and len(config_changed) == 0
-        )
-        if quiet:
-            self._quiet_ticks += 1
-            if self._quiet_ticks > max(2 * self.rotate_ticks,
-                                       self.rotate_ticks + 3):
-                return TickHandle(
-                    out=None,
-                    sel_rows=np.zeros(0, np.int64),
-                    rids=np.zeros(0, np.int32),
-                    versions=np.zeros(0, np.uint64),
-                    keep_has=np.zeros(0, np.uint8),
-                    n_sel=0,
-                    dispatched_at=now,
-                )
-        else:
-            self._quiet_ticks = 0
+    def _drained_empty(self, drained) -> bool:
+        return len(drained[0]) == 0
+
+    def _launch(self, res_list, drained, config_changed, now, ph):
+        flat_idx, levels, slot_rids, raw_slots = drained
 
         # Delivery set (chunk rows). Full-dirty rows (membership /
         # client-reported has) and config-changed segments always
@@ -586,9 +413,15 @@ class WideResidentSolver:
         # while the set stays small (beyond the budget the rotation
         # covers them within a refresh interval — the module docstring
         # explains why that bound is the reference's own staleness).
+        W = self._W
         full_mask = levels >= 2
         dirty_rows = flat_idx // W
-        rot = self._rotation_rows()
+        rot = self._rotation_rows(
+            self._R,
+            self._Rp // self._meshrows.n_dev
+            if self._meshrows is not None
+            else 0,
+        )
         if self._just_rebuilt or config_changed is None:
             self._just_rebuilt = False
             sel = np.arange(max(self._R, 1), dtype=np.int64)
@@ -657,22 +490,23 @@ class WideResidentSolver:
             f_s[fpos : fpos + nf_i] = psub[fm]
             f_a[fpos : fpos + nf_i] = pact[fm].astype(bool)
             fpos += nf_i
-        lap("pack")
+        ph.lap("pack")
 
         keep = np.zeros(n_sel, np.uint8)
         if n_sel:
             segs = self._row_seg_h[sel]
-            keep = self._learn_h[segs].astype(np.uint8)
+            keep = self._config.learn_h[segs].astype(np.uint8)
         if self._meshrows is not None:
             return self._stage_mesh(
                 w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
                 sel, sel_rids, sel_chunks, versions, keep, now, ph,
             )
 
-        Dw = _ceil_to(n_w, 1024)
-        Df = _ceil_to(n_f, 256)
-        Sb = _ceil_to(n_sel, 32)
+        Dw = ceil_to(n_w, 1024)
+        Df = ceil_to(n_f, 256)
+        Sb = ceil_to(n_sel, 32)
         pad_slot = self._R * W  # padding row slot 0
+        idt = self._idx_dtype
 
         def padded(arr, width, fill):
             out = np.full((width,) + arr.shape[1:], fill, arr.dtype)
@@ -680,30 +514,39 @@ class WideResidentSolver:
             return out
 
         sel_pad = np.resize(sel, Sb) if n_sel else np.zeros(Sb, np.int64)
-        put = self._put
-        tick = self._tick_fn(Dw, Df, Sb)
-        staged = (
-            put(padded(w_idx, Dw, pad_slot)),
-            put(padded(w_val, Dw, 0)),
-            put(padded(f_idx, Df, pad_slot)),
-            put(padded(f_w, Df, 0)),
-            put(padded(f_h, Df, 0)),
-            put(padded(f_s, Df, 0)),
-            put(padded(f_a, Df, False)),
-            put(sel_pad.astype(np.int32)),
+        lanes = self._config.lanes()
+        w_val_block = padded(w_val, Dw, 0)
+        # Compact upload of the wants-value block (bf16 when exact; see
+        # engine.bf16_exact) and int32 flat indices when the table fits.
+        if _BF16 is not None and bf16_exact(w_val_block):
+            w_val_block = w_val_block.astype(_BF16)
+        host_blocks = (
+            padded(w_idx, Dw, pad_slot).astype(idt),
+            w_val_block,
+            padded(f_idx, Df, pad_slot).astype(idt),
+            padded(f_w, Df, 0),
+            padded(f_h, Df, 0),
+            padded(f_s, Df, 0),
+            padded(f_a, Df, False),
+            sel_pad.astype(np.int32),
         )
-        lap("upload")
+        ph.lap("staging")
+        put = self._put
+        tick = self._tick_fn(Dw, Df, Sb, lanes)
+        staged = tuple(put(b) for b in host_blocks)
+        ph.lap("upload")
+        cfg = self._config
         (
             self._wants, self._has, self._sub, self._act, out
         ) = tick(
             self._wants, self._has, self._sub, self._act,
             *staged,
-            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
         )
         from doorman_tpu.utils.transfer import start_download
 
         out = start_download(out)
-        lap("solve")
+        ph.lap("solve")
         return TickHandle(
             out=out,
             sel_rows=sel,
@@ -717,7 +560,7 @@ class WideResidentSolver:
 
     def _stage_mesh(self, w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
                     sel, sel_rids, sel_chunks, versions, keep, now, ph):
-        """Mesh tail of dispatch(): slot scatters and the delivery set
+        """Mesh tail of the launch: slot scatters and the delivery set
         grouped by owning shard; per-shard blocks land only on their
         own device, the shard_mapped tick solves with the bit-stable
         psum reduction, and the delivery downloads one stream per
@@ -735,6 +578,7 @@ class WideResidentSolver:
         Rl = self._Rp // n_dev
         span = Rl * W
         n_sel = len(sel)
+        idt = self._idx_dtype
 
         ow = w_idx // span
         counts_w, (w_idx_l, w_val_l) = group_by_shard(
@@ -752,12 +596,15 @@ class WideResidentSolver:
             owner_sel, n_dev, [sel - owner_sel * Rl]
         )
 
-        Dw = _ceil_to(int(counts_w.max()) if len(w_idx) else 1, 1024)
-        Df = _ceil_to(int(counts_f.max()) if len(f_idx) else 1, 256)
-        Sb = _ceil_to(int(counts_sel.max()) if n_sel else 1, 32)
+        Dw = ceil_to(int(counts_w.max()) if len(w_idx) else 1, 1024)
+        Df = ceil_to(int(counts_f.max()) if len(f_idx) else 1, 256)
+        Sb = ceil_to(int(counts_sel.max()) if n_sel else 1, 32)
         w_idx_b, w_val_b = pad_shard_blocks(
             counts_w, Dw, [(w_idx_l, span), (w_val_l, 0)]
         )
+        w_idx_b = w_idx_b.astype(idt)
+        if _BF16 is not None and bf16_exact(w_val_b):
+            w_val_b = w_val_b.astype(_BF16)
         f_idx_b, f_w_b, f_h_b, f_s_b, f_a_b = pad_shard_blocks(
             counts_f, Df,
             [
@@ -765,13 +612,17 @@ class WideResidentSolver:
                 (f_a_l, False),
             ],
         )
+        f_idx_b = f_idx_b.astype(idt)
         sel_b = pad_shard_indices(counts_sel, Sb, sel_l).astype(np.int32)
+        lanes = self._config.lanes()
+        ph.lap("staging")
 
         itemsize = self._dtype.itemsize
+        idx_bytes = np.dtype(idt).itemsize
         ph.shard_bytes(
             "upload",
-            counts_w * (8 + itemsize)
-            + counts_f * (8 + 3 * itemsize + 1)
+            counts_w * (idx_bytes + itemsize)
+            + counts_f * (idx_bytes + 3 * itemsize + 1)
             + counts_sel * 4,
         )
         ph.shard_bytes(
@@ -779,18 +630,19 @@ class WideResidentSolver:
             counts_sel * W * np.dtype(self._out_dtype).itemsize,
         )
         put = self._put_rows
-        tick = self._tick_fn_mesh(Dw, Df, Sb)
+        tick = self._tick_fn_mesh(Dw, Df, Sb, lanes)
         staged = (
             put(w_idx_b), put(w_val_b), put(f_idx_b), put(f_w_b),
             put(f_h_b), put(f_s_b), put(f_a_b), put(sel_b),
         )
         ph.lap("upload")
+        cfg = self._config
         (
             self._wants, self._has, self._sub, self._act, out
         ) = tick(
             self._wants, self._has, self._sub, self._act,
             self._row_seg_d, *staged,
-            self._cap_d, self._kind_d, self._learn_d, self._statc_d,
+            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
         )
         out = start_sharded_download(out)
         ph.lap("solve")
@@ -806,35 +658,11 @@ class WideResidentSolver:
             shard_counts=counts_sel,
         )
 
-    def collect(self, handle: TickHandle) -> int:
-        """Write one tick's downloaded grant rows back into the engine;
-        chunks whose membership version moved mid-flight are skipped
-        (their re-marked slots re-deliver them next tick)."""
-        if handle.collected:
-            return 0
-        handle.collected = True
-        if handle.out is None:
-            self.ticks += 1
-            self.idle_ticks += 1
-            self.last_tick_seconds = self._clock() - handle.dispatched_at
-            return 0
-        ph = PhaseRecorder("resident_wide", self.phase_s)
-        gets = landed_rows(handle)
-        ph.lap("download")
-        applied = self._engine.apply_chunks(
+    def _apply_grants(self, handle: TickHandle, gets: np.ndarray) -> int:
+        return self._engine.apply_chunks(
             handle.rids,
             handle.chunks,
             gets,
             handle.keep_has,
             handle.versions,
         )
-        ph.lap("apply")
-        self.ticks += 1
-        self.last_tick_seconds = self._clock() - handle.dispatched_at
-        return applied
-
-    def step(
-        self, resources: Sequence[Resource], config_epoch: int = 0
-    ) -> int:
-        """Sequential convenience: dispatch + collect immediately."""
-        return self.collect(self.dispatch(resources, config_epoch))

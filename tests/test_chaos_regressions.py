@@ -211,12 +211,12 @@ def test_resident_overflow_clears_both_resident_handles():
             wide.store.assign(f"w{i}", 60.0, 1.0, 0.0, 1.0, 1)
         await server.tick_once()
         await server.tick_once()
-        assert server._resident_handle is not None
-        assert server._resident_wide_handle is not None
+        assert len(server._resident_pipe) > 0
+        assert len(server._resident_wide_pipe) > 0
         state.start(plan_event)
         await server.tick_once()  # overflow -> BatchSolver fallback
-        assert server._resident_handle is None
-        assert server._resident_wide_handle is None, (
+        assert len(server._resident_pipe) == 0
+        assert len(server._resident_wide_pipe) == 0, (
             "fallback tick left a stale wide handle collectable"
         )
         await server.stop()

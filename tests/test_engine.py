@@ -1,0 +1,611 @@
+"""The tick-engine conformance suite + admission-fused staging parity.
+
+One contract, four solver paths: `solver/engine.py` owns the stage
+skeleton (staging -> solve -> delivery) and the shared chokepoints; the
+single-device resident, mesh resident, wide (chunked), mesh-wide, and
+BatchTickAdapter paths all implement the same dispatch/collect/step
+surface. This suite pins the contract ACROSS the paths, so a
+stage-contract change cannot drift just one of them (it subsumes the
+parity overlap of the per-path suites, which keep their path-specific
+scenarios):
+
+  * conformance: the dispatch/collect surface (idempotent collect,
+    tick counters, the engine phase vocabulary) and cross-path store
+    parity against the BatchSolver ground truth over churn that mixes
+    bf16-exact and non-exact wants — so the compact transfer encodings
+    (engine.bf16_exact, engine.compact_index_dtype) are pinned
+    byte-identical by the same run;
+  * pipelining: PipelinedTicker depth semantics — deferred write-back
+    converges to the same fixpoint, drop() is benign, foreign-solver
+    handles are dropped not collected;
+  * fused staging: byte-identity of the admission-fused staging path
+    vs the store->drain->pack round trip, solver-level and server-level
+    (native + python stores, mixed priority bands, has-carrying
+    refreshes); a mid-window mastership flip falls back to the
+    round-trip path cleanly;
+  * loud out-of-range dirty rids: the row-LUT alias assert and the
+    engine anomaly hook.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.core.resource import Resource
+from doorman_tpu.parallel import make_mesh
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.solver.batch import BatchSolver
+from doorman_tpu.solver.engine import (
+    PHASES,
+    BatchTickAdapter,
+    PipelinedTicker,
+    bf16_exact,
+    compact_index_dtype,
+)
+from doorman_tpu.solver.resident import ResidentDenseSolver
+from doorman_tpu.solver.resident_wide import WideResidentSolver
+from tests.test_resident_solver import all_leases, make_world
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+# Wide float tolerance (two-level chunk reduction re-associates sums;
+# see tests/test_resident_wide.py for the bound's derivation).
+RTOL = 1e-9
+ATOL = 1e-9
+
+PATHS = ("batch", "resident", "resident_mesh", "wide", "wide_mesh")
+
+
+def make_path(path, engine, clock):
+    """One tick engine per path name, all over the same world shape."""
+    if path == "batch":
+        return BatchTickAdapter(BatchSolver(dtype=np.float64, clock=clock))
+    mesh = make_mesh() if path.endswith("_mesh") else None
+    if path.startswith("resident"):
+        return ResidentDenseSolver(
+            engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+            mesh=mesh,
+        )
+    # chunk width 8 over 9 clients/resource: every resource spans two
+    # chunk rows (the straddling case the mesh-wide path must reduce
+    # bit-stably).
+    return WideResidentSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+        chunk_width=8, mesh=mesh,
+    )
+
+
+def conformance_churn(resources, step, rng):
+    """Shared mutation stream: wants churn (alternating bf16-exact
+    small integers and non-bf16-exact fractions, so both compact-upload
+    encodings are exercised and pinned), releases, and new clients."""
+    res = resources[step % len(resources)]
+    i = resources.index(res)
+    wants = (
+        float(rng.integers(1, 200))
+        if step % 2 == 0
+        # 1/3 does not round-trip bfloat16: forces the full-width
+        # wants upload (bf16_exact False) on odd steps.
+        else float(rng.integers(1, 200)) + 1.0 / 3.0
+    )
+    res.store.assign(
+        f"c{i}_0", 60.0, 5.0, res.store.get(f"c{i}_0").has, wants, 1
+    )
+    if step % 3 == 1:
+        res2 = resources[(step * 7) % len(resources)]
+        res2.store.release(f"c{resources.index(res2)}_1")
+    if step % 3 == 2:
+        res3 = resources[(step * 5) % len(resources)]
+        res3.store.assign(
+            f"new{step}_{resources.index(res3)}", 60.0, 5.0, 0.0,
+            float(rng.integers(1, 50)), 2,
+        )
+
+
+def assert_store_parity(ref, got, path, msg=""):
+    """Narrow paths are byte-identical to the BatchSolver; the wide
+    paths carry the documented two-level reassociation tolerance."""
+    assert ref.keys() == got.keys(), f"{path} membership diverged {msg}"
+    for key in ref:
+        if path.startswith("wide"):
+            np.testing.assert_allclose(
+                got[key], ref[key], rtol=RTOL, atol=ATOL,
+                err_msg=f"{path} lease {key} {msg}",
+            )
+        else:
+            assert got[key] == ref[key], (
+                f"{path} lease {key} {msg}: {got[key]} != {ref[key]}"
+            )
+
+
+def test_conformance_store_parity_across_all_paths():
+    """The load-bearing pin: one churn stream through every path, the
+    BatchSolver world as ground truth, stores compared per tick."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    worlds = {p: make_world(clock) for p in PATHS}
+    engines = {
+        p: make_path(p, worlds[p][0], clock) for p in PATHS
+    }
+    rngs = {p: np.random.default_rng(99) for p in PATHS}
+    for step in range(8):
+        for p in PATHS:
+            conformance_churn(worlds[p][1], step, rngs[p])
+        if step == 4:
+            # Learning-mode flip: the config epoch bump makes every
+            # engine re-read templates mid-run.
+            for p in PATHS:
+                worlds[p][1][2].learning_mode_end = t[0] + 2.5
+        epoch = 1 if step >= 4 else 0
+        for p in PATHS:
+            engines[p].step(worlds[p][1], epoch)
+        ref = all_leases(worlds["batch"][1])
+        for p in PATHS:
+            if p == "batch":
+                continue
+            assert_store_parity(
+                ref, all_leases(worlds[p][1]), p, f"step {step}"
+            )
+        t[0] += 1.0
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_dispatch_collect_contract(path):
+    """The stage-skeleton contract every path honors: dispatch returns
+    a collectible handle, collect is idempotent, counters move, and
+    the phase vocabulary is the engine's (batch keeps its own
+    pack/solve/apply subset)."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    engine, resources = make_world(clock)
+    eng = make_path(path, engine, clock)
+
+    handle = eng.dispatch(resources, 0)
+    assert eng.collect(handle) >= 0
+    assert eng.collect(handle) == 0  # idempotent: nothing applies twice
+    assert eng.ticks == 1
+    assert eng.step(resources, 0) >= 0
+    assert eng.ticks == 2
+    assert eng.last_tick_seconds >= 0.0
+    if isinstance(eng, BatchTickAdapter):
+        assert {"pack", "solve", "apply"} <= set(eng.phase_s)
+    else:
+        assert set(PHASES) <= set(eng.phase_s)
+        # The engine laps real phases (staging is host-side assembly,
+        # split from the device placement "upload").
+        assert eng.phase_s["staging"] > 0.0
+        assert eng.phase_s["upload"] > 0.0
+
+
+@pytest.mark.parametrize("path", ("resident", "wide"))
+def test_idle_fast_path_conformance(path):
+    """Quiet stores cost no device work on every resident path: after
+    two full rotations with no changes, ticks are served idle — and
+    any write resumes real ticks immediately."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    engine, resources = make_world(clock)
+    eng = make_path(path, engine, clock)
+    for _ in range(12):
+        eng.step(resources, 0)
+        t[0] += 0.5
+    assert eng.idle_ticks > 0
+    idle_before = eng.idle_ticks
+    resources[0].store.assign(
+        "c0_0", 60.0, 5.0, resources[0].store.get("c0_0").has, 7.0, 1
+    )
+    eng.step(resources, 0)
+    assert eng.idle_ticks == idle_before  # a write resumed real ticks
+
+
+@pytest.mark.parametrize("path", ("resident", "wide"))
+def test_pipelined_ticker_depth2_converges(path):
+    """Depth-2 pipelining defers each tick's write-back one tick; once
+    churn stops, the flushed store converges to the same fixpoint as
+    the collect-before-dispatch reference. drop() mid-run is benign
+    (uncollected grants re-deliver through rotation), and a foreign
+    solver's handle is dropped, never collected."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    ref = make_path(path, eng_a, clock)
+    piped = make_path(path, eng_b, clock)
+    pipe = PipelinedTicker(depth=2)
+    assert pipe.depth == 2
+
+    rng_a, rng_b = (np.random.default_rng(7) for _ in range(2))
+    for step in range(6):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        ref.step(res_a, 0)
+        pipe.step(piped, res_b, 0)
+        if step == 3:
+            pipe.drop()  # a mastership flip would drop in-flight work
+        t[0] += 1.0
+    # Quiesce: no more churn; rotation re-delivers everything (rotate=1
+    # here, so two quiet ticks cover the dropped tick's rows too).
+    for _ in range(3):
+        ref.step(res_a, 0)
+        pipe.step(piped, res_b, 0)
+        t[0] += 1.0
+    assert pipe.flush(piped) > 0
+    assert len(pipe) == 0
+    assert_store_parity(
+        all_leases(res_a), all_leases(res_b), path, "after flush"
+    )
+    # Foreign handles: a replacement solver's step drops the old
+    # solver's in-flight handle instead of collecting it.
+    stale = piped.dispatch(res_b, 0)
+    pipe._queue.append((piped, stale))
+    replacement = make_path(path, eng_b, clock)
+    pipe.depth = 1
+    pipe.step(replacement, res_b, 0)
+    assert not stale.collected
+    pipe.flush()
+
+
+# ----------------------------------------------------------------------
+# Admission-fused staging parity
+# ----------------------------------------------------------------------
+
+
+def fused_churn(resources, res_rids, solver, step, rng):
+    """The churn stream replayed as admission windows: write the store,
+    then stage the touched rows (exactly what the coalescer's grouped
+    pass does through server._fused_stage). Mixed has-carrying
+    refreshes and releases ride along."""
+    touched = set()
+    res = resources[step % len(resources)]
+    i = resources.index(res)
+    res.store.assign(
+        f"c{i}_0", 60.0, 5.0, res.store.get(f"c{i}_0").has,
+        float(rng.integers(1, 200)), 1,
+    )
+    touched.add(i)
+    if step % 2 == 1:
+        res2 = resources[(step * 3) % len(resources)]
+        i2 = resources.index(res2)
+        res2.store.assign(
+            f"c{i2}_2", 60.0, 5.0, res2.store.get(f"c{i2}_2").has,
+            float(rng.integers(1, 100)), 1,
+        )
+        touched.add(i2)
+    if solver is not None:
+        solver.stage_rids(res_rids[sorted(touched)])
+    return touched
+
+
+def test_fused_staging_solver_parity():
+    """Byte-identity of the fused staging path vs the round-trip pack
+    at the solver level, with an untracked-write invalidation in the
+    middle (the stale entry must NOT ship)."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    plain = ResidentDenseSolver(
+        eng_a, dtype=np.float64, clock=clock, rotate_ticks=1
+    )
+    fused = ResidentDenseSolver(
+        eng_b, dtype=np.float64, clock=clock, rotate_ticks=1
+    )
+    staging = fused.attach_staging()
+    assert fused.attach_staging() is staging  # idempotent
+    rids_a = np.array([r.store._rid for r in res_a], np.int32)
+    rids_b = np.array([r.store._rid for r in res_b], np.int32)
+
+    rng_a, rng_b = (np.random.default_rng(21) for _ in range(2))
+    fused_hits = 0
+    for step in range(10):
+        fused_churn(res_a, rids_a, None, step, rng_a)
+        touched = fused_churn(res_b, rids_b, fused, step, rng_b)
+        if step == 5:
+            # An untracked writer (e.g. a release path) touches a row
+            # AFTER the window staged it: without invalidation the
+            # fused tick would ship the stale pack and the write's
+            # consumed dirty flag would lose it.
+            i = sorted(touched)[0]
+            for world, rid_arr, solver in (
+                (res_a, rids_a, None), (res_b, rids_b, fused),
+            ):
+                world[i].store.assign(
+                    f"c{i}_3", 60.0, 5.0,
+                    world[i].store.get(f"c{i}_3").has, 123.0, 1,
+                )
+                if solver is not None:
+                    solver.staging.invalidate(int(rid_arr[i]))
+        plain.step(res_a, 0)
+        fused.step(res_b, 0)
+        fused_hits += fused.last_fused["rows"]
+        assert_store_parity(
+            all_leases(res_a), all_leases(res_b), "resident",
+            f"fused step {step}",
+        )
+        t[0] += 1.0
+    assert fused_hits > 0  # the cache actually served rows
+    st = staging.status()
+    assert st["windows_total"] >= 9 and st["staged_rows_total"] > 0
+
+
+def test_fused_staging_wholesale_invalidate_on_sweep():
+    """An expiry sweep that removes anything invalidates the whole
+    cache (the sweep does not say which rows): the next tick falls
+    back to the round-trip pack and stays byte-identical."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    plain = ResidentDenseSolver(
+        eng_a, dtype=np.float64, clock=clock, rotate_ticks=1
+    )
+    fused = ResidentDenseSolver(
+        eng_b, dtype=np.float64, clock=clock, rotate_ticks=1
+    )
+    fused.attach_staging()
+    rids_b = np.array([r.store._rid for r in res_b], np.int32)
+    plain.step(res_a, 0)
+    fused.step(res_b, 0)
+    # Short-lease clients expire over the jump; the sweep's clean_all
+    # removes them and must clear the staged pack below.
+    for world in (res_a, res_b):
+        world[0].store.assign("moth", 2.0, 1.0, 0.0, 9.0, 1)
+    fused.stage_rids(rids_b[:1])
+    t[0] += 30.0  # "moth" expires
+    plain.step(res_a, 0)
+    fused.step(res_b, 0)
+    assert fused.last_fused["rows"] == 0  # cache was dropped, not used
+    assert_store_parity(
+        all_leases(res_a), all_leases(res_b), "resident", "post sweep"
+    )
+
+
+def test_out_of_range_dirty_rid_is_loud_when_aliasing():
+    """The satellite pin: an out-of-range dirty rid must resolve to
+    "not ours" through the reserved -1 slot — silently aliasing it onto
+    a live row (the old `lut[np.minimum(...)]` behavior) corrupts that
+    row's upload. Benign case: rids registered after the rebuild drain
+    away quietly. Corrupt case: a reserved slot pointing at a real row
+    raises AND fires the anomaly hook."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    engine, resources = make_world(clock)
+    solver = ResidentDenseSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=1
+    )
+    solver.step(resources, 0)
+
+    # Benign: a resource created after the rebuild dirties a rid above
+    # the LUT; the tick ignores it (it is not in this solver's table).
+    tpl = pb.ResourceTemplate(
+        identifier_glob="late", capacity=10.0,
+        algorithm=pb.Algorithm(
+            kind=pb.Algorithm.PROPORTIONAL_SHARE,
+            lease_length=60, refresh_interval=5,
+        ),
+    )
+    late = Resource("late", tpl, clock=clock, store_factory=engine.store)
+    late.store.assign("lc", 60.0, 5.0, 0.0, 5.0, 1)
+    solver.step(resources, 0)  # no raise; the late rid drains to -1
+
+    # Corrupt: the reserved trailing slot aliases row 0. A rid CLAMPED
+    # onto it (strictly past the LUT — `late2` is one rid beyond
+    # `late`, which sat exactly on the reserved index) must refuse to
+    # scatter another resource's writes into row 0 — loud assert plus
+    # an anomaly instant for the flight recorder.
+    tpl2 = pb.ResourceTemplate(
+        identifier_glob="later", capacity=10.0,
+        algorithm=pb.Algorithm(
+            kind=pb.Algorithm.PROPORTIONAL_SHARE,
+            lease_length=60, refresh_interval=5,
+        ),
+    )
+    late2 = Resource("later", tpl2, clock=clock, store_factory=engine.store)
+    events = []
+    solver.on_anomaly = lambda kind, detail: events.append((kind, detail))
+    solver._row_lut[-1] = 0
+    late2.store.assign("lc2", 60.0, 5.0, 0.0, 7.0, 1)
+    with pytest.raises(AssertionError, match="alias"):
+        solver.dispatch(resources, 0)
+    assert events and events[0][0] == "dirty_rid_alias"
+    assert events[0][1]["aliased_rows"] == [0]
+
+
+# ----------------------------------------------------------------------
+# Server-level fused parity (the coalescer as the tracked write path)
+# ----------------------------------------------------------------------
+
+SERVER_CONFIG = """
+resources:
+- identifier_glob: "fair*"
+  capacity: 300
+  algorithm: {kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60,
+              refresh_interval: 1, learning_mode_duration: 0}
+"""
+
+
+async def _make_batch_server(fuse, native_store, clock):
+    from doorman_tpu.admission import Admission
+    from doorman_tpu.server.config import parse_yaml_config
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    server = CapacityServer(
+        f"eng-{'fused' if fuse else 'plain'}",
+        TrivialElection(),
+        mode="batch", tick_interval=60.0,  # ticks driven manually
+        minimum_refresh_interval=0.0,
+        native_store=native_store,
+        clock=clock,
+        admission=Admission(coalesce_window=0.05),
+        fuse_admission=fuse,
+        flightrec_capacity=64,
+    )
+    await server.start(0, host="127.0.0.1")
+    await server.load_config(parse_yaml_config(SERVER_CONFIG))
+    await asyncio.sleep(0)
+    return server
+
+
+def _server_requests(round_index, prev=None):
+    """Mixed bands over both resources; later rounds carry each path's
+    own grants as `has` (a refreshing population)."""
+    reqs = []
+    for i in range(6):
+        cid = f"cl{i}"
+        req = pb.GetCapacityRequest(client_id=cid)
+        for rid in (["fair0"] if i % 2 else ["fair0", "prop"]):
+            rr = req.resource.add()
+            rr.resource_id = rid
+            rr.wants = 10.0 * (i + 1) + round_index
+            rr.priority = i % 3
+            if prev is not None:
+                for resp in prev[cid].response:
+                    if resp.resource_id == rid:
+                        rr.has.CopyFrom(resp.gets)
+        reqs.append(req)
+    return reqs
+
+
+async def _drive_window(server, reqs):
+    tasks = [
+        asyncio.create_task(server.GetCapacity(req, None)) for req in reqs
+    ]
+    outs = await asyncio.gather(*tasks)
+    return {req.client_id: out for req, out in zip(reqs, outs)}
+
+
+def _store_rows(server):
+    return {
+        rid: sorted(res.store.dump_rows())
+        for rid, res in server.resources.items()
+    }
+
+
+@pytest.mark.parametrize("native_store", [False, True],
+                         ids=["python-store", "native-store"])
+def test_fused_server_parity(native_store):
+    """End to end: the fused admission->engine staging path must be
+    byte-identical (responses AND stores) to the round-trip path, over
+    coalesced windows with mixed bands and has-carrying refreshes and
+    manual batch ticks between rounds. On the Python store the fuse
+    flag must be a clean no-op (no resident path exists to fuse)."""
+
+    async def body():
+        class Clock:
+            t = 1_000.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        plain = await _make_batch_server(False, native_store, clock)
+        fused = await _make_batch_server(True, native_store, clock)
+        try:
+            prev_p = await _drive_window(plain, _server_requests(0))
+            prev_f = await _drive_window(fused, _server_requests(0))
+            for rnd in range(1, 4):
+                await plain.tick_once()
+                await fused.tick_once()
+                clock.t += 1.0
+                prev_p = await _drive_window(
+                    plain, _server_requests(rnd, prev_p)
+                )
+                prev_f = await _drive_window(
+                    fused, _server_requests(rnd, prev_f)
+                )
+                assert {
+                    c: r.SerializeToString() for c, r in prev_p.items()
+                } == {
+                    c: r.SerializeToString() for c, r in prev_f.items()
+                }, f"responses diverged in round {rnd}"
+                assert _store_rows(plain) == _store_rows(fused), (
+                    f"stores diverged in round {rnd}"
+                )
+            if native_store:
+                st = fused._resident.staging.status()
+                assert st["windows_total"] > 0  # fusion actually ran
+                assert plain._resident.staging is None
+            else:
+                assert fused._resident is None  # nothing to fuse
+        finally:
+            await plain.stop()
+            await fused.stop()
+
+    asyncio.run(body())
+
+
+def test_fused_mid_window_mastership_flip_falls_back():
+    """A mastership flip mid-window: parked requests get redirects, the
+    resident solver (and its staging cache) is dropped with the flip,
+    and the next mastership serves through a clean round-trip rebuild."""
+
+    async def body():
+        class Clock:
+            t = 1_000.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        server = await _make_batch_server(True, True, clock)
+        try:
+            prev = await _drive_window(server, _server_requests(0))
+            await server.tick_once()
+            solver = server._resident
+            assert solver is not None and solver.staging is not None
+
+            # Requests park; the flip lands before the window flushes.
+            tasks = [
+                asyncio.create_task(server.GetCapacity(req, None))
+                for req in _server_requests(1, prev)
+            ]
+            await asyncio.sleep(0)
+            await server._on_is_master(False)
+            outs = await asyncio.gather(*tasks)
+            assert all(not out.response for out in outs)  # redirects
+            assert server._resident is None  # solver dropped with flip
+            assert len(server._resident_pipe) == 0
+
+            # Back to master: a fresh solver, a fresh (empty) cache,
+            # ticks run clean through the round-trip rebuild.
+            await server._on_is_master(True)
+            await _drive_window(server, _server_requests(2))
+            await server.tick_once()
+            await server.tick_once()  # collects the first tick's handle
+            assert server._resident is not None
+            assert server._resident.ticks >= 1
+            assert server._resident.staging.status()["pending_rows"] == 0
+        finally:
+            await server.stop()
+
+    asyncio.run(body())
+
+
+# ----------------------------------------------------------------------
+# Compact transfer encodings
+# ----------------------------------------------------------------------
+
+
+def test_bf16_exact_predicate():
+    # Small integers round-trip bfloat16 exactly; 1/3 and large odd
+    # integers do not; empty blocks never qualify.
+    assert bf16_exact(np.arange(256, dtype=np.float64))
+    assert not bf16_exact(np.array([1.0 / 3.0]))
+    assert not bf16_exact(np.array([257.0]))  # needs 9 mantissa bits
+    assert not bf16_exact(np.zeros(0))
+
+
+def test_compact_index_dtype():
+    assert compact_index_dtype(2**20) == np.int32
+    assert compact_index_dtype(2**31) == np.int64
