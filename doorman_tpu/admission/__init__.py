@@ -22,7 +22,6 @@ See doc/admission.md for the controller math and the operator story.
 from __future__ import annotations
 
 import random
-import time
 from typing import Dict, Optional
 
 from doorman_tpu.admission.coalesce import Coalescer

@@ -117,11 +117,14 @@ class Client:
         tls_ca: Optional[str] = None,
         max_retries: Optional[int] = None,
         clock: Callable[[], float] = time.time,
+        retry_rng: Optional[random.Random] = None,
     ):
         """`max_retries` bounds each RPC's internal retry loop (None =
         the reference's retry-forever). `clock` is the wall-clock used
         for lease-expiry decisions; the chaos harness injects a virtual
-        clock here so outage expiry is deterministic."""
+        clock here so outage expiry is deterministic. `retry_rng` is the
+        matching randomness seam: pass a seeded random.Random to pin the
+        retry/shed jitter in replayed runs."""
         self.id = client_id or _default_client_id()
         self._clock = clock
         self.conn = Connection(
@@ -138,8 +141,9 @@ class Client:
         # Private jitter stream for retry pacing (full jitter on the
         # backoff ladder; half-jitter on server retry-after hints) —
         # decorrelates the fleet's retry waves. Private so nothing
-        # else's draws interleave with it.
-        self._retry_rng = random.Random()
+        # else's draws interleave with it; unseeded only when the
+        # caller injected nothing (production).
+        self._retry_rng = retry_rng if retry_rng is not None else random.Random()
         # Metrics hook (method, duration_s, error); the obs module's
         # instrument_client replaces this (reference client.go:87-99).
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
@@ -299,7 +303,9 @@ class Client:
             if soonest is None
             else max(1.0, min(REFRESH_RPC_BOUND, soonest - now))
         )
-        start = time.monotonic()
+        # RPC-duration telemetry for the metrics hook only — never
+        # drives behavior, so it stays on the real clock by design.
+        start = time.monotonic()  # doorman: allow[seeded-determinism]
         shed_after: Optional[float] = None
         try:
             # Metadata resolves inside the lambda, per attempt, under
@@ -336,7 +342,12 @@ class Client:
         # The hook runs outside the RPC try: a raising user callback must
         # not be misclassified as an RPC outage (or kill the loop).
         try:
-            self.on_request("GetCapacity", time.monotonic() - start, failed)
+            # Telemetry duration (see `start` above).
+            self.on_request(
+                "GetCapacity",
+                time.monotonic() - start,  # doorman: allow[seeded-determinism]
+                failed,
+            )
         except Exception:
             log.exception("%s: on_request hook raised", self.id)
         if failed:

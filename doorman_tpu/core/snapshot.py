@@ -20,7 +20,6 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.solver.kernels import EdgeBatch, ResourceBatch
 
 

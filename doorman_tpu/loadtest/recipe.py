@@ -23,7 +23,7 @@ import random
 import re
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 __all__ = ["Recipe", "RecipeError", "WorkerState", "parse_recipes"]
 

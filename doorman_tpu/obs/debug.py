@@ -53,7 +53,7 @@ ROUTES = (
 )
 
 _parts_lock = threading.Lock()
-_parts: Dict[str, Callable[[], str]] = {}
+_parts: Dict[str, Callable[[], str]] = {}  # guarded-by: _parts_lock
 _start_time = time.time()
 
 

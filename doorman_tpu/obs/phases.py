@@ -25,7 +25,7 @@ every phase into the first bucket.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import trace as trace_mod

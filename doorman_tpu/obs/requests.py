@@ -36,7 +36,7 @@ class RequestLog:
     _lock: threading.Lock = field(init=False)
 
     def __post_init__(self) -> None:
-        self._entries = deque(maxlen=self.capacity)
+        self._entries = deque(maxlen=self.capacity)  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def record(

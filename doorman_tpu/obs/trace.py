@@ -47,6 +47,8 @@ from collections import deque
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 __all__ = [
+    "KNOWN_INSTANT_NAMES",
+    "KNOWN_SPAN_NAMES",
     "TRACE_METADATA_KEY",
     "Span",
     "SpanContext",
@@ -64,6 +66,29 @@ __all__ = [
 # client -> server and intermediate -> parent hops. Keys must be
 # lowercase ASCII for gRPC.
 TRACE_METADATA_KEY = "doorman-trace"
+
+# The span/instant vocabularies. Trace consumers join on these names —
+# Perfetto overlays, /debug/traces summaries, test assertions, and the
+# route tables in doc/observability.md — so an unregistered name records
+# into a stream nobody reads. doormanlint (trace-phase-hygiene) checks
+# every `.span(...)`/`.instant(...)` literal against these sets; a
+# `prefix.*` entry admits computed suffixes (f"server.{method}").
+# Phase-lap names live next to the stage skeleton instead
+# (solver/engine.py PHASES).
+KNOWN_SPAN_NAMES = frozenset({
+    "server.tick",
+    "server.parent_refresh",
+    "server.*",  # per-RPC handler spans: server.GetCapacity, ...
+    "client.refresh",
+    "client.GetCapacity",
+    "admission.window",
+    "persist.snapshot",
+    "persist.restore",
+})
+KNOWN_INSTANT_NAMES = frozenset({
+    "election.transition",
+    "shard.*",  # per-direction mesh transfer instants: shard.upload, ...
+})
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must
 # be monotonic; wall clocks step and skew.

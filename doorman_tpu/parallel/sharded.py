@@ -17,7 +17,6 @@ of BASELINE.json config 4; `dc_aggregates` exposes the per-dc partials
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

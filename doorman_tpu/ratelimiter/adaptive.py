@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from doorman_tpu.client.client import ClientResource
 from doorman_tpu.ratelimiter.qps import QPSRateLimiter
@@ -43,17 +43,26 @@ def wants_estimate(entries: List[float], window: float, now: float) -> float:
 
 
 class AdaptiveQPSRateLimiter:
-    def __init__(self, resource: ClientResource, window: float = DEFAULT_WINDOW):
+    def __init__(
+        self,
+        resource: ClientResource,
+        window: float = DEFAULT_WINDOW,
+        clock: Callable[[], float] = time.time,
+    ):
+        # `clock` is the injectable time seam (chaos hands every
+        # component its virtual ChaosClock); entry timestamps and window
+        # expiry both read it so a replayed run ages entries identically.
         self._resource = resource
         self._limiter = QPSRateLimiter(resource)
         self._window = window
+        self._clock = clock
         self._entries: List[float] = []
         self._task = asyncio.create_task(self._run())
 
     async def _run(self) -> None:
         while True:
             await asyncio.sleep(self._window)
-            now = time.time()
+            now = self._clock()
             self._entries = [t for t in self._entries if now - t < self._window]
             wants = wants_estimate(self._entries, self._window, now)
             if wants > 0:
@@ -63,7 +72,7 @@ class AdaptiveQPSRateLimiter:
                     log.exception("resource.ask failed")
 
     async def wait(self, timeout: Optional[float] = None) -> None:
-        self._entries.append(time.time())
+        self._entries.append(self._clock())
         await self._limiter.wait(timeout)
 
     async def close(self) -> None:
@@ -76,6 +85,8 @@ class AdaptiveQPSRateLimiter:
 
 
 def new_adaptive_qps(
-    resource: ClientResource, window: float = DEFAULT_WINDOW
+    resource: ClientResource,
+    window: float = DEFAULT_WINDOW,
+    clock: Callable[[], float] = time.time,
 ) -> AdaptiveQPSRateLimiter:
-    return AdaptiveQPSRateLimiter(resource, window)
+    return AdaptiveQPSRateLimiter(resource, window, clock=clock)

@@ -171,12 +171,15 @@ class EtcdKV(LeaseKV):
         executor thread by then, and an unbounded floor would let that
         orphan keep hammering etcd endpoints with doomed requests for
         the rest of its sequence during a partition."""
-        end = time.monotonic() + budget
+        # Wall clock by design (here and below): these budgets pace real
+        # etcd sockets. Chaos virtualizes time ABOVE this seam, at the
+        # election-KV / gateway injectors, so replays never reach these.
+        end = time.monotonic() + budget  # doorman: allow[seeded-determinism]
         floor = 0.1 * len(self._gw.endpoints)
         floored = [False]
 
         def t() -> float:
-            remaining = end - time.monotonic()
+            remaining = end - time.monotonic()  # doorman: allow[seeded-determinism]
             if remaining <= 0:
                 if floored[0]:
                     raise TimeoutError(
@@ -313,7 +316,7 @@ class EtcdKV(LeaseKV):
         # window remains, which is nearly everything when the first
         # attempt failed fast. DEFINITE losses (lease TTL 0, key not
         # ours) never retry.
-        deadline = time.monotonic() + 0.5 * ttl
+        deadline = time.monotonic() + 0.5 * ttl  # doorman: allow[seeded-determinism]
         budget = min(self.REQUEST_TIMEOUT, 0.32 * ttl)
 
         outcome: "bool | None" = None
@@ -353,7 +356,7 @@ class EtcdKV(LeaseKV):
                 raise
             if outcome is not None:
                 break
-            remaining = deadline - time.monotonic()
+            remaining = deadline - time.monotonic()  # doorman: allow[seeded-determinism]
             if remaining <= 0.05 * ttl:
                 break  # no meaningful retry window left
             budget = min(self.REQUEST_TIMEOUT, remaining / 1.25)
@@ -386,7 +389,7 @@ class EtcdKV(LeaseKV):
         gateway's lenient contract, and without a floor the watch loop
         would hammer etcd back-to-back (the polling default this
         replaced was bounded to one get per interval)."""
-        start = time.monotonic()
+        start = time.monotonic()  # doorman: allow[seeded-determinism]
         ok = False
         try:
             ok = await asyncio.get_running_loop().run_in_executor(
@@ -399,7 +402,7 @@ class EtcdKV(LeaseKV):
             self._fast_watches = 0
             await asyncio.sleep(min(timeout, 1.0))
             return
-        if time.monotonic() - start < 0.05:
+        if time.monotonic() - start < 0.05:  # doorman: allow[seeded-determinism]
             # A genuine change can return this fast once or twice in a
             # row (re-election storm); only a degenerate watch does so
             # indefinitely. Escalate to the full poll interval then.

@@ -68,13 +68,16 @@ class EtcdGateway:
         millisecond) still leaves the healthy ones a real share, while
         one that fails fast (connection refused) barely dents the
         budget and later endpoints inherit nearly all of it."""
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  # doorman: allow[seeded-determinism]
         for i, endpoint in enumerate(self.endpoints):
-            per = (deadline - time.monotonic()) / (len(self.endpoints) - i)
+            per = (deadline - time.monotonic()) / (len(self.endpoints) - i)  # doorman: allow[seeded-determinism]
             if per <= 0:
                 return
             yield endpoint, per
 
+    # The allow[seeded-determinism] marks in this file are deliberate:
+    # failover deadlines pace real HTTP requests; chaos replaces the
+    # whole gateway (FakeEtcd / injectors), never this layer's clock.
     def _post(self, path: str, payload: dict, timeout: float = 30.0) -> dict:
         data = json.dumps(payload).encode()
         last_err: Exception = RuntimeError("no endpoints")
@@ -224,11 +227,11 @@ class EtcdGateway:
         # across calls: an endpoint that fails before establishing a
         # watch is skipped on the next call (the caller loops), so one
         # burned cycle moves the watch to a healthy endpoint for good.
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + timeout  # doorman: allow[seeded-determinism]
         n = len(self.endpoints)
         start = self._watch_endpoint  # snapshot: the loop mutates it
         for j in range(n):
-            per = deadline - time.monotonic()
+            per = deadline - time.monotonic()  # doorman: allow[seeded-determinism]
             if per <= 0:
                 break
             i = (start + j) % n

@@ -23,7 +23,7 @@ import asyncio
 import contextvars
 import logging
 import time
-from typing import Awaitable, Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import grpc
 
@@ -563,6 +563,9 @@ class CapacityServer(CapacityServicer):
             log.exception("%s: anomaly record failed", self.id)
 
     # -- admission-fused staging hooks ---------------------------------
+    # (the tracked-writer registry FUSED_TRACKED_WRITERS lives at module
+    # level below the class; doormanlint's fused-writer-discipline rule
+    # reads it)
 
     def _fused_stage(self, resource_ids) -> None:
         """Coalescer hook, called right after a window's grouped store
@@ -813,7 +816,11 @@ class CapacityServer(CapacityServicer):
                         "%s: resident bucket overflow; re-partitioning "
                         "wide resources", self.id,
                     )
-                    self._resident_ok_key = None
+                    # Executor-thread write, but serialized: the loop
+                    # awaits this callable under _tick_lock, and the
+                    # only reader (_resident_eligible) runs at the next
+                    # tick's start, after the await completes.
+                    self._resident_ok_key = None  # doorman: allow[lock-discipline]
                     self._resident_pipe.drop()
                     self._resident_wide_pipe.drop()
                     run_tick()
@@ -1585,3 +1592,40 @@ class CapacityServer(CapacityServicer):
         if res is None:
             return None
         return res.store.lease_status()
+
+
+# ----------------------------------------------------------------------
+# Fused-staging tracked-writer registry (machine-checked)
+# ----------------------------------------------------------------------
+# The FusedStaging freshness contract (solver/engine.py): a window-time
+# pack cache entry is valid only while no store write touched its row
+# after staging. doormanlint's fused-writer-discipline rule requires
+# every store-row writer in this file and admission/coalesce.py to
+# either call _fused_invalidate (release paths, band sub-leases, band
+# sweeps do) or appear here with the audit note saying who owns its
+# staging obligation. Adding a writer to this list is a CONTRACT CLAIM
+# — include the argument, like the entries below.
+FUSED_TRACKED_WRITERS = frozenset({
+    # The coalescer's grouped pass is THE tracked writer: it re-stages
+    # everything it wrote via _fused_stage at window close and drops the
+    # whole cache on a partially-applied window. (It calls both hooks
+    # inline, so it self-certifies; listed for documentation.)
+    "Coalescer._decide_batch",
+    # _decide writes one row per call; its three call sites own the
+    # contract: Coalescer._decide_batch re-stages after the window's
+    # writes, _get_server_capacity invalidates after the band loop, and
+    # GetCapacity's direct loop only runs with admission off (below).
+    "CapacityServer._decide",
+    # The direct per-request loop runs only when admission is None
+    # (coalescing otherwise owns every GetCapacity decide), and fused
+    # staging is attached iff fuse_admission AND admission coalescing
+    # are active (_resident_solver) — so on this path the staging cache
+    # provably does not exist.
+    "CapacityServer.GetCapacity",
+    # Mastership transitions swap the store engine and null the
+    # resident solvers before persist.restore writes the fresh engine:
+    # the staged cache dies with the old solver (engine handles are
+    # meaningless across the swap), and a new cache cannot exist until
+    # a new solver is built after this method returns.
+    "CapacityServer._on_is_master",
+})

@@ -104,7 +104,9 @@ class Sim:
     def __init__(self, seed: int = 0):
         self.clock = SimClock()
         self.scheduler = Scheduler(self.clock)
-        self.varz = Varz()
+        # Gauge timers measure on the virtual axis: a sim report is a
+        # pure function of (seed, scenario), never of host speed.
+        self.varz = Varz(clock=self.clock)
         self.random = random.Random(seed)
         # Populated by the model layer.
         self.server_jobs: List = []

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from doorman_tpu.algorithms import Request, get_algorithm, get_parameter
 from doorman_tpu.core.lease import Lease

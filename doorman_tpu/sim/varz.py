@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import math
 import time
-from typing import Dict, Iterator
+from typing import Callable, Dict, Iterator
 
 
 class Counter:
@@ -19,13 +19,17 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self, name: str):
+    def __init__(self, name: str, clock: Callable[[], float] = time.monotonic):
+        # The timer clock is injectable so a Sim's gauges measure on its
+        # virtual axis (deterministic reports) while a standalone Gauge
+        # keeps wall time.
         self.name = name
         self.value = 0.0
         self.n = 0
         self.min_value = math.inf
         self.max_value = -math.inf
         self.average = 0.0
+        self._clock = clock
         self._timer_start = 0.0
 
     def set(self, value: float) -> None:
@@ -36,19 +40,20 @@ class Gauge:
         self.average += (value - self.average) / self.n
 
     def start_timer(self) -> None:
-        self._timer_start = time.monotonic()
+        self._timer_start = self._clock()
 
     def stop_timer(self) -> None:
-        self.set(time.monotonic() - self._timer_start)
+        self.set(self._clock() - self._timer_start)
         self._timer_start = 0.0
 
 
 class Varz:
     """Per-simulation metric registry."""
 
-    def __init__(self):
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
+        self._clock = clock
 
     def counter(self, name: str) -> Counter:
         if name not in self._counters:
@@ -57,7 +62,7 @@ class Varz:
 
     def gauge(self, name: str) -> Gauge:
         if name not in self._gauges:
-            self._gauges[name] = Gauge(name)
+            self._gauges[name] = Gauge(name, clock=self._clock)
         return self._gauges[name]
 
     def counters(self) -> Iterator[Counter]:

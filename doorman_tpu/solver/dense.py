@@ -22,7 +22,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
-import jax.numpy as jnp
 
 from doorman_tpu.solver.lanes import solve_lanes
 

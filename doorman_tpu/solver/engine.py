@@ -36,8 +36,8 @@ import logging
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -353,11 +353,13 @@ class FusedStaging:
     def __init__(self, engine):
         self._engine = engine
         self._lock = threading.Lock()
-        self._cache: Dict[int, tuple] = {}
-        self.windows = 0  # windows staged since the last take()
-        self.staged_rows = 0
-        self.total_windows = 0  # lifetime (status pages)
-        self.total_staged_rows = 0
+        self._cache: Dict[int, tuple] = {}  # guarded-by: self._lock
+        # Window tallies staged since the last take(), and lifetime
+        # totals (status pages); same lock as the cache they describe.
+        self.windows = 0  # guarded-by: self._lock
+        self.staged_rows = 0  # guarded-by: self._lock
+        self.total_windows = 0  # guarded-by: self._lock
+        self.total_staged_rows = 0  # guarded-by: self._lock
 
     def stage(self, rids, kfill: int) -> int:
         """Pack the given engine rids from the store at the current lane

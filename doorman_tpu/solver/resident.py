@@ -61,7 +61,7 @@ byte-identical to BatchSolver's (both call solver.dense/solve_lanes).
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, List, Sequence
 
 import numpy as np
 
@@ -77,7 +77,6 @@ from doorman_tpu.solver.engine import (
     TickHandle,
     bf16_exact,
     ceil_to,
-    landed_rows,
     place,
 )
 from doorman_tpu.solver.engine import _BF16

@@ -45,7 +45,7 @@ def wait_for_backend(
                     "import jax; jax.devices(); print('ok')"]
     reason = "backend probe never ran"
     for attempt in range(1, attempts + 1):
-        attempt_start = time.monotonic()
+        attempt_start = time.monotonic()  # doorman: allow[seeded-determinism]
         if callable(probe_argv):
             argv = probe_argv()
         else:
@@ -96,7 +96,7 @@ def wait_for_backend(
         if attempt < attempts:
             # Pace fast failures to the attempt window: the point is to
             # span the blip, not to burn every attempt in seconds.
-            elapsed = time.monotonic() - attempt_start
+            elapsed = time.monotonic() - attempt_start  # doorman: allow[seeded-determinism]
             time.sleep(max(0.0, per_timeout_s - elapsed))
     return reason
 

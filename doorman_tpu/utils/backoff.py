@@ -22,7 +22,7 @@ MIN_BACKOFF = 1.0
 MAX_BACKOFF = 60.0
 VERY_LONG_TIME = 60.0 * 60
 
-_JITTER_RNG = random.Random()
+_JITTER_RNG = random.Random()  # doorman: allow[seeded-determinism]
 
 
 def backoff(base: float, maximum: float, retries: int, *,

@@ -1,0 +1,2 @@
+"""Repo tooling: drive scripts (tools/drives) and the doormanlint
+static-analysis suite (tools/lint, `python -m tools.lint`)."""

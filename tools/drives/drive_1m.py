@@ -6,7 +6,6 @@ latency under concurrent load. The server's own tick loop is parked
 double-ticking would inflate the latencies via the tick lock."""
 
 import asyncio
-import sys
 import time
 
 import numpy as np

@@ -3,7 +3,6 @@ grants within ~2 ticks) and a mastership flip (fresh engine, recovery).
 The server's own tick loop drives the ticks."""
 
 import asyncio
-import sys
 import time
 
 from _common import pin_platform_in_process, require_backend, load_1m

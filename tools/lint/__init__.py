@@ -1,0 +1,33 @@
+"""doormanlint: repo-native static analysis for doorman-tpu's contracts.
+
+The contracts this repo runs on are not general Python hygiene — they
+are doorman-specific invariants that used to live only in docstrings
+and reviewer memory:
+
+  * device code must not close over host scalars that change kernel
+    dtypes (the PR-4 pallas IntEnum regression class),
+  * the engine's stage skeleton must not host-sync outside delivery,
+  * every untracked store writer must invalidate the fused staging
+    cache (the PR-7 freshness contract),
+  * chaos-reachable modules must take time and randomness only through
+    injectable seams,
+  * `# guarded-by:` state must be touched under its lock,
+  * span/phase names must come from the obs registries.
+
+Each contract is an AST checker in tools/lint/checkers; the framework
+here is pure stdlib (no jax import — it runs in a bare CPU CI job in
+well under a second). Run with `python -m tools.lint`; suppress a
+finding in place with `# doorman: allow[rule]`; tolerate legacy
+findings via the committed baseline (tools/lint/baseline.json). See
+doc/lint.md.
+"""
+
+from tools.lint.core import (  # noqa: F401  (re-exports)
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,7 @@
+"""`python -m tools.lint` entry point."""
+
+import sys
+
+from tools.lint.cli import main
+
+sys.exit(main())

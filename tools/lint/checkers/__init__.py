@@ -1,0 +1,28 @@
+"""The doormanlint rule set. Each module holds one checker; ALL_CHECKERS
+is the registry the CLI and `run_lint` resolve by default."""
+
+from tools.lint.checkers.determinism import SeededDeterminism
+from tools.lint.checkers.fused_writer import FusedWriterDiscipline
+from tools.lint.checkers.host_sync import HostSyncInHotPath
+from tools.lint.checkers.jit_capture import JitClosureCapture
+from tools.lint.checkers.locks import LockDiscipline
+from tools.lint.checkers.phase_hygiene import TracePhaseHygiene
+
+ALL_CHECKERS = (
+    JitClosureCapture,
+    HostSyncInHotPath,
+    FusedWriterDiscipline,
+    SeededDeterminism,
+    LockDiscipline,
+    TracePhaseHygiene,
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "JitClosureCapture",
+    "HostSyncInHotPath",
+    "FusedWriterDiscipline",
+    "SeededDeterminism",
+    "LockDiscipline",
+    "TracePhaseHygiene",
+]

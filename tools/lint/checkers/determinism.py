@@ -1,0 +1,140 @@
+"""seeded-determinism: chaos-reachable modules take time and randomness
+only through injectable seams.
+
+Chaos replays are byte-stable because every time read in the stack goes
+through an injectable clock (chaos.clock.ChaosClock) and every random
+draw through a seeded rng handed in by the plan. A direct
+`time.time()` / `time.monotonic()` / `random.random()` /
+`datetime.now()` in a chaos-reachable module silently escapes the
+virtual clock: the run still passes locally and the replay diverges
+under load, which is the worst kind of flake.
+
+What stays legal, by construction rather than by suppression:
+
+  * the seam itself — `clock: Callable[[], float] = time.time` as a
+    default argument is a reference, not a call, and never matches;
+  * seeded construction — `random.Random(seed)` with arguments;
+  * the injectable-fallback idiom — an argless `random.Random()` inside
+    a conditional expression or `or`-chain choosing against an injected
+    rng (`rng if rng is not None else random.Random()`).
+
+Genuinely wall-clock behavior (RPC deadlines against real sockets,
+election retry budgets) carries `# doorman: allow[seeded-determinism]`
+with its reason — the point is that every escape from virtual time is
+explicit and reviewed, not that none exist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.core import Checker, FileContext, Finding, RepoContext, call_name
+
+# Module prefixes the chaos runner (or the sim kernel) can reach.
+CHAOS_REACHABLE = (
+    "doorman_tpu/server/",
+    "doorman_tpu/solver/",
+    "doorman_tpu/admission/",
+    "doorman_tpu/persist/",
+    "doorman_tpu/chaos/",
+    "doorman_tpu/sim/",
+    "doorman_tpu/client/",
+    "doorman_tpu/core/",
+    "doorman_tpu/ratelimiter/",
+    "doorman_tpu/utils/",
+)
+
+_TIME_CALLS = {"time.time", "time.monotonic"}
+_DATETIME_CALLS = {
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "date.today", "datetime.date.today",
+}
+# Module-level functions of `random` that draw from the global
+# (process-seeded) state.
+_RANDOM_FUNCS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "expovariate",
+    "betavariate", "triangular", "getrandbits", "randbytes", "vonmisesvariate",
+}
+_OTHER_CALLS = {"uuid.uuid4", "os.urandom", "secrets.token_bytes",
+                "secrets.token_hex"}
+
+
+class SeededDeterminism(Checker):
+    name = "seeded-determinism"
+    description = (
+        "time.time()/random.*/datetime.now() in chaos-reachable modules "
+        "must go through the injectable clock/rng seams"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        if not ctx.relpath.startswith(CHAOS_REACHABLE):
+            return
+        # The virtual clock itself documents/aliases time.time.
+        if ctx.relpath.endswith("chaos/clock.py"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _TIME_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() escapes the injectable clock seam; take a "
+                    "`clock: Callable[[], float]` parameter (default "
+                    f"{name}) and call that instead",
+                )
+            elif name in _DATETIME_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() is wall-clock; route through the injectable "
+                    "clock seam",
+                )
+            elif name in _OTHER_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() is nondeterministic; chaos replays cannot "
+                    "pin it — draw from an injected seeded rng",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "random"
+                and node.func.attr in _RANDOM_FUNCS
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"random.{node.func.attr}() draws from the global rng; "
+                    "use an injected seeded random.Random",
+                )
+            elif (
+                name in ("random.Random", "Random")
+                and not node.args
+                and not node.keywords
+                and not self._is_seam_fallback(ctx, node)
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "random.Random() without a seed is nondeterministic; "
+                    "seed it, or make it the fallback of an injectable "
+                    "rng parameter (`rng if rng is not None else "
+                    "random.Random()`)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and ast.unparse(node.func).startswith(("np.random.", "numpy.random."))
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() uses numpy's global rng; use an injected "
+                    "np.random.Generator (or a seeded Random)",
+                )
+
+    @staticmethod
+    def _is_seam_fallback(ctx: FileContext, node: ast.Call) -> bool:
+        """True for `rng if rng is not None else random.Random()` and
+        `rng or random.Random()`: the injectable seam's default leg."""
+        parent = ctx.parents.get(node)
+        return isinstance(parent, (ast.IfExp, ast.BoolOp))

@@ -1,0 +1,100 @@
+"""fused-writer-discipline: the PR-7 fused-staging freshness contract.
+
+`engine.FusedStaging` serves a tick's upload pack from a window-time
+cache; a cache entry is valid only while no store write touched its row
+after staging. Tracked writers (the admission coalescer's grouped pass)
+re-stage what they write; EVERY other writer of lease-store rows must
+drop the touched rows from the cache via `_fused_invalidate` — a writer
+that does neither ships a pre-write pack whose dirty flag the next
+drain consumes, and the store of record silently diverges from the
+device table (the exact bug class doc/bench.md's parity rules pin).
+
+Machine check: in the contract modules (server/server.py and
+admission/coalesce.py), any function that calls a store-writing method
+(`assign`, `release`, `decide`, `decide_fast`, `refresh_grant`,
+`bulk_assign`, `bulk_refresh`, `regrant`, `restore`, `clean`,
+`clean_all` — or `_decide`, which wraps them) must either
+
+  * call `_fused_invalidate` (or `_fused_stage`) somewhere in its own
+    body, or
+  * appear in the `FUSED_TRACKED_WRITERS` registry next to
+    `_fused_invalidate` in server/server.py — the audited list of
+    writers whose staging obligations are owned elsewhere (the
+    coalescer re-stages; callers invalidate; or staging is provably
+    detached on that path).
+
+Adding a store write to a new RPC path without deciding its staging
+story now fails CI instead of shipping a one-in-a-thousand stale grant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.core import (
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    attr_tail,
+    enclosing_functions,
+    qualname,
+)
+
+SCOPE_FILES = (
+    "doorman_tpu/server/server.py",
+    "doorman_tpu/admission/coalesce.py",
+)
+
+# Store-row mutators across LeaseStore / NativeLeaseStore / Resource,
+# plus the server's _decide wrapper (calling it IS writing).
+WRITER_METHODS = {
+    "assign", "regrant", "release", "restore", "bulk_assign",
+    "bulk_refresh", "decide", "decide_fast", "refresh_grant",
+    "clean", "clean_all", "_decide",
+}
+_FUSED_HOOKS = {"_fused_invalidate", "_fused_stage"}
+
+
+class FusedWriterDiscipline(Checker):
+    name = "fused-writer-discipline"
+    description = (
+        "store-row writers in server/coalesce must be registered in "
+        "FUSED_TRACKED_WRITERS or call _fused_invalidate"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        if ctx.relpath not in SCOPE_FILES:
+            return
+        # function node -> first writer call seen (for the report site)
+        writers = {}
+        handles = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = attr_tail(node)
+            funcs = enclosing_functions(ctx, node)
+            if not funcs:
+                continue
+            # Obligations attach to the outermost def: a nested helper's
+            # writes are the enclosing method's staging problem.
+            owner = funcs[-1]
+            if tail in _FUSED_HOOKS:
+                handles.add(owner)
+            elif tail in WRITER_METHODS and isinstance(node.func, ast.Attribute):
+                writers.setdefault(owner, node)
+        for func, call in writers.items():
+            if func in handles:
+                continue
+            qn = qualname(ctx, func)
+            if qn in repo.tracked_writers:
+                continue
+            yield self.finding(
+                ctx, call,
+                f"{qn} writes store rows (.{attr_tail(call)}) but neither "
+                "calls _fused_invalidate/_fused_stage nor appears in "
+                "FUSED_TRACKED_WRITERS (server/server.py): a staged pack "
+                "of the touched row would ship pre-write values "
+                "(engine.FusedStaging freshness contract)",
+            )

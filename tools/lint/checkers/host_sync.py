@@ -1,0 +1,135 @@
+"""host-sync-in-hot-path: the engine stage skeleton may only host-sync
+in delivery.
+
+The tick engines (solver/engine.py TickEngineBase and its resident
+implementations) phase every tick through the stage skeleton — sweep,
+drain, config, pack, staging, upload, solve — and the whole sub-100 ms
+budget rests on those phases never blocking on the device: a
+`.item()` / `block_until_ready()` / `jax.device_get()` /
+`np.asarray(<device value>)` inside staging or solve serializes the
+host against the solve it was supposed to overlap. Delivery ("download"
+and "apply" laps) is where grants legitimately land on the host.
+
+Statically we cannot always know a value is device-resident, so the
+rule is anchored on the phase structure instead: inside any function
+that laps a PhaseRecorder (`ph.lap("<phase>")`), statements are
+attributed to the phase whose lap closes them (laps time the code
+ABOVE them), and the listed sync constructs are flagged in every
+segment except download/apply. Host-side numpy staging work is fine —
+np.asarray on fresh host data is only flagged when its argument smells
+device-sourced (a name bound from a solve/tick/device call) — while
+`.item()`, `.block_until_ready()` and `jax.device_get()` have no
+host-side reading and are flagged unconditionally.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from tools.lint.core import Checker, FileContext, Finding, RepoContext
+
+SCOPE = ("doorman_tpu/solver/",)
+
+DELIVERY_PHASES = {"download", "apply"}
+
+# Unconditional device syncs.
+_HARD_SYNC_ATTRS = {"block_until_ready", "item"}
+_HARD_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+# Conditional: host conversions that sync when fed a device value.
+_SOFT_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SOFT_SYNC_NAMES = {"float", "bool", "int"}
+# A name assigned from a call whose text mentions one of these is
+# treated as device-sourced for the soft checks.
+_DEVICE_SOURCES = ("solve", "pallas_call", "device_put", "_tick_fn", "dispatch")
+
+
+def _lap_schedule(func: ast.AST) -> List[Tuple[int, str]]:
+    laps = []
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("lap", "record")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            laps.append((node.lineno, node.args[0].value))
+    laps.sort()
+    return laps
+
+
+def _phase_at(laps: List[Tuple[int, str]], lineno: int) -> Optional[str]:
+    for lap_line, phase in laps:
+        if lineno <= lap_line:
+            return phase
+    return None  # after the last lap: not a timed phase
+
+
+class HostSyncInHotPath(Checker):
+    name = "host-sync-in-hot-path"
+    description = (
+        "float()/bool()/.item()/np.asarray/block_until_ready on device "
+        "values inside engine stage-skeleton phases other than delivery"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        if not ctx.relpath.startswith(SCOPE):
+            return
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            laps = _lap_schedule(func)
+            if not laps:
+                continue
+            device_names = self._device_sourced_names(func)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                phase = _phase_at(laps, node.lineno)
+                if phase is None or phase in DELIVERY_PHASES:
+                    continue
+                msg = self._sync_reason(node, device_names)
+                if msg:
+                    yield self.finding(
+                        ctx, node,
+                        f"{msg} in stage-skeleton phase {phase!r}: host "
+                        "syncs belong in delivery (download/apply) — keep "
+                        "this phase async against the device",
+                    )
+
+    @staticmethod
+    def _device_sourced_names(func: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                txt = ast.unparse(node.value.func)
+                if any(m in txt for m in _DEVICE_SOURCES):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+                        elif isinstance(tgt, ast.Tuple):
+                            names.update(
+                                e.id for e in tgt.elts if isinstance(e, ast.Name)
+                            )
+        return names
+
+    @staticmethod
+    def _sync_reason(node: ast.Call, device_names: Set[str]) -> Optional[str]:
+        txt = ast.unparse(node.func)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HARD_SYNC_ATTRS:
+            return f".{node.func.attr}() device sync"
+        if txt in _HARD_SYNC_CALLS:
+            return f"{txt}() device sync"
+        arg_mentions_device = any(
+            isinstance(n, ast.Name) and n.id in device_names
+            for a in node.args for n in ast.walk(a)
+        )
+        if txt in _SOFT_SYNC_CALLS and arg_mentions_device:
+            return f"{txt}() on a device-sourced value"
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _SOFT_SYNC_NAMES and arg_mentions_device:
+            return f"{node.func.id}() on a device-sourced value"
+        return None

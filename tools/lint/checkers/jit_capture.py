@@ -1,0 +1,136 @@
+"""jit-closure-capture: the PR-4 pallas regression class, machine-checked.
+
+Six tier-1 tests failed for five PR rounds because `solve_lanes`
+compared a device column against `AlgoKind.FAIR_SHARE` directly: an
+IntEnum operand becomes a strong-typed int64 scalar constant under
+tracing, and a pallas kernel body rejects any non-ref closure constant
+(and even under plain jit the int64 const flips weak-typed arithmetic).
+The fix is one character-cheap seam — `int(kind)` keeps the operand a
+weak-typed Python literal — but nothing enforced it; this checker does.
+
+Scope: device-code functions in solver/ and parallel/ modules — a
+function is device code when it
+
+  * is decorated with jit (`@jax.jit`, `@partial(jax.jit, ...)`), or
+  * is (or is nested in) a pallas kernel: passed to `pl.pallas_call` /
+    `pallas_call`, or named `kernel` / `*_kernel`, or
+  * references `jnp.` / `jax.lax` in its body (lane math that gets
+    inlined into kernels, exactly like solve_lanes was).
+
+Inside such functions, any comparison or arithmetic whose operand is a
+bare `<IntEnumClass>.<MEMBER>` attribute is flagged unless the operand
+is wrapped in `int(...)`. IntEnum classes are discovered from the
+scanned tree (class X(enum.IntEnum)), so new enums are covered the day
+they are written.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from tools.lint.core import (
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    enclosing_functions,
+)
+
+SCOPE = ("doorman_tpu/solver/", "doorman_tpu/parallel/")
+
+_DEVICE_NAME_MARKS = ("jnp", "pl")
+
+
+def _is_jit_decorated(func: ast.AST) -> bool:
+    for dec in getattr(func, "decorator_list", []):
+        txt = ast.unparse(dec)
+        if "jit" in txt.split("(")[0] or "jax.jit" in txt:
+            return True
+    return False
+
+
+def _kernel_names(tree: ast.AST) -> Set[str]:
+    """Names of functions passed (positionally first) to pallas_call."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = ast.unparse(node.func)
+            if fname.endswith("pallas_call") and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+    return out
+
+
+def _references_device_api(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and node.id in _DEVICE_NAME_MARKS:
+            return True
+        if isinstance(node, ast.Attribute):
+            txt = ast.unparse(node)
+            if txt.startswith(("jax.lax", "jnp.", "pl.")):
+                return True
+    return False
+
+
+class JitClosureCapture(Checker):
+    name = "jit-closure-capture"
+    description = (
+        "IntEnum members closed over in pallas kernels / jitted solve "
+        "functions must pass through int() (the PR-4 regression class)"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        if not ctx.relpath.startswith(SCOPE):
+            return
+        enums = repo.int_enum_classes
+        if not enums:
+            return
+        kernels = _kernel_names(ctx.tree)
+        device_fns = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (
+                _is_jit_decorated(node)
+                or node.name in kernels
+                or node.name == "kernel"
+                or node.name.endswith("_kernel")
+                or _references_device_api(node)
+            ):
+                device_fns.append(node)
+        for func in device_fns:
+            yield from self._check_function(ctx, func, enums, device_fns)
+
+    def _check_function(self, ctx, func, enums, device_fns):
+        for node in ast.walk(func):
+            # Attribute nodes reached through a *nested* device fn are
+            # reported once, at the innermost device function.
+            inner = enclosing_functions(ctx, node)
+            if inner and inner[0] is not func and inner[0] in device_fns:
+                continue
+            operands = []
+            if isinstance(node, ast.Compare):
+                operands = [node.left, *node.comparators]
+            elif isinstance(node, ast.BinOp):
+                operands = [node.left, node.right]
+            for op in operands:
+                enum_txt = self._bare_enum_member(op, enums)
+                if enum_txt is not None:
+                    yield self.finding(
+                        ctx, op,
+                        f"{enum_txt} used as a traced operand: an IntEnum "
+                        "materializes a strong-typed int64 closure const "
+                        "that pallas kernels reject (PR-4 regression "
+                        f"class); wrap it as int({enum_txt})",
+                    )
+
+    @staticmethod
+    def _bare_enum_member(node: ast.AST, enums) -> "str | None":
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in enums
+        ):
+            return ast.unparse(node)
+        return None

@@ -1,0 +1,193 @@
+"""lock-discipline: `# guarded-by:` state is touched under its lock.
+
+The coalescer stages from its executor while the tick executor takes;
+the debug server answers from its own threads; the PipelinedTicker and
+flight recorder straddle the event loop and the tick thread. The repo's
+convention for all of that shared state is a declaration at the
+assignment site:
+
+    self._cache: Dict[int, tuple] = {}  # guarded-by: self._lock
+
+This checker enforces the declaration: every later load or store of a
+guarded attribute (or guarded module global) must sit lexically inside
+`with <that lock>:`. Two escape hatches, both explicit:
+
+  * `# holds-lock: self._lock` on a def line — the caller owns the
+    lock; the body is treated as locked (the classic private-helper
+    pattern);
+  * `# doorman: allow[lock-discipline]` with a reason for the genuinely
+    benign cases (reading a monotonically-published float, CPython
+    atomic swaps).
+
+Nested functions deliberately do NOT inherit the lexically-held lock:
+a closure handed to an executor runs later, on another thread — which
+is also the second half of this rule: any callable submitted to an
+executor (`run_in_executor`, `.submit`, `call_soon_threadsafe`) that
+mutates `self.*` state without holding SOME lock is flagged, guarded
+or not. Cross-thread mutation with no lock at all is how the
+coalescer/ticker races of tomorrow get written.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.lint.core import (
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    WithLockMap,
+    enclosing_class,
+    enclosing_functions,
+)
+
+_EXECUTOR_CALLS = {"run_in_executor", "submit", "call_soon_threadsafe"}
+
+
+class LockDiscipline(Checker):
+    name = "lock-discipline"
+    description = (
+        "# guarded-by: attributes accessed outside their lock, and "
+        "executor-submitted callables mutating shared state lock-free"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        guarded = self._collect_guarded(ctx)
+        if guarded:
+            yield from self._check_guarded(ctx, guarded)
+        yield from self._check_executor_callables(ctx, guarded)
+
+    # -- declaration scan ---------------------------------------------
+
+    def _collect_guarded(self, ctx: FileContext
+                         ) -> Dict[Tuple[Optional[str], str], Tuple[str, ast.AST]]:
+        """(class name | None for module level, attr) -> (lock text,
+        declaring function node | None)."""
+        out: Dict[Tuple[Optional[str], str], Tuple[str, ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = ctx.guarded_marker(node.lineno)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            cls = enclosing_class(ctx, node)
+            funcs = enclosing_functions(ctx, node)
+            decl_fn = funcs[0] if funcs else None
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and cls is not None
+                ):
+                    out[(cls.name, tgt.attr)] = (lock, decl_fn)
+                elif isinstance(tgt, ast.Name) and cls is None and decl_fn is None:
+                    out[(None, tgt.id)] = (lock, None)
+        return out
+
+    # -- guarded access enforcement -----------------------------------
+
+    def _check_guarded(self, ctx, guarded) -> Iterator[Finding]:
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if enclosing_functions(ctx, func):
+                continue  # nested defs are visited through their parent's map
+            cls = enclosing_class(ctx, func)
+            lockmap = WithLockMap.build(func)
+            held_extra = ctx.holds_marker(func)
+            for node in ast.walk(func):
+                key = None
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and cls is not None
+                ):
+                    key = (cls.name, node.attr)
+                elif isinstance(node, ast.Name):
+                    key = (None, node.id)
+                if key is None or key not in guarded:
+                    continue
+                lock, decl_fn = guarded[key]
+                if decl_fn is func or (decl_fn is None and func.name == "__init__"):
+                    continue  # construction site
+                inner = enclosing_functions(ctx, node)
+                inner_fn = inner[0] if inner else func
+                if inner_fn is not func and ctx.holds_marker(inner_fn) == lock:
+                    continue
+                if inner_fn is func and held_extra == lock:
+                    continue
+                if lockmap.holds(node, lock):
+                    continue
+                attr = key[1]
+                yield self.finding(
+                    ctx, node,
+                    f"{attr} is declared `# guarded-by: {lock}` but is "
+                    f"accessed outside `with {lock}` (annotate the def "
+                    f"with `# holds-lock: {lock}` if the caller holds it)",
+                )
+
+    # -- executor-submitted callables ---------------------------------
+
+    def _check_executor_callables(self, ctx, guarded) -> Iterator[Finding]:
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local_defs = {
+                n.name: n
+                for n in ast.walk(func)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not func
+            }
+            for node in ast.walk(func):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EXECUTOR_CALLS
+                ):
+                    continue
+                for arg in node.args:
+                    target: Optional[ast.AST] = None
+                    if isinstance(arg, ast.Lambda):
+                        target = arg
+                    elif isinstance(arg, ast.Name) and arg.id in local_defs:
+                        target = local_defs[arg.id]
+                    if target is None:
+                        continue
+                    yield from self._check_submitted(ctx, target)
+
+    def _check_submitted(self, ctx, target) -> Iterator[Finding]:
+        """A callable that will run on another thread: flag bare
+        mutations of self.* state done with no lock held at all."""
+        if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                ctx.holds_marker(target):
+            return
+        lockmap = WithLockMap.build(target)
+        stores: List[ast.Attribute] = []
+        for node in ast.walk(target):
+            tgts: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                tgts = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                tgts = [node.target]
+            for tgt in tgts:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and not lockmap.held_at.get(node)
+                ):
+                    stores.append(tgt)
+        for tgt in stores:
+            name = getattr(target, "name", "<lambda>")
+            yield self.finding(
+                ctx, tgt,
+                f"executor-submitted callable {name!r} mutates "
+                f"self.{tgt.attr} without holding any lock: it runs on "
+                "another thread, racing the event loop (take a lock or "
+                "annotate the def with # holds-lock:)",
+            )

@@ -1,0 +1,125 @@
+"""trace-phase-hygiene: span and phase names come from the registries;
+spans cannot leak.
+
+Every telemetry surface in this repo joins on names: bench.py,
+/debug/status, the flight recorder and the SLO engine all read the
+phase vocabulary (solver/engine.py PHASES); trace consumers (Perfetto
+overlays, test assertions, doc/observability.md's route tables) key on
+span/instant names (obs/trace.py KNOWN_SPAN_NAMES / KNOWN_INSTANT_NAMES,
+where `prefix.*` entries admit computed suffixes like
+f"server.{method}"). A typo'd name doesn't fail — it silently records
+into a stream nobody reads, which is why it is a lint rule and not a
+runtime error.
+
+Pairing: `tracer.span(...)` returns a context manager that must be
+ENTERED — a span opened without `with` never closes and poisons
+open-span accounting (Tracer.open_spans). The blessed shapes are the
+`with` statement itself and the span-factory idiom (`return
+tracer.span(...)` from a function whose name ends in `_span`, which
+callers then enter). Everything else is an unmatched begin.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from tools.lint.core import Checker, FileContext, Finding, RepoContext
+
+# The registries' own modules define the vocabulary; don't lint them
+# against themselves.
+_SELF_FILES = ("doorman_tpu/obs/trace.py", "doorman_tpu/obs/phases.py")
+
+
+def _name_ok(name: str, registry: Set[str]) -> bool:
+    if name in registry:
+        return True
+    return any(
+        entry.endswith(".*") and name.startswith(entry[:-1])
+        for entry in registry
+    )
+
+
+def _fstring_prefix(node: ast.JoinedStr) -> str:
+    """Leading literal text of an f-string ('' when it starts with a
+    placeholder)."""
+    if node.values and isinstance(node.values[0], ast.Constant):
+        return str(node.values[0].value)
+    return ""
+
+
+class TracePhaseHygiene(Checker):
+    name = "trace-phase-hygiene"
+    description = (
+        "span/phase names must come from the obs registries; spans must "
+        "be entered with `with` (or returned from a *_span factory)"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        if not ctx.relpath.startswith("doorman_tpu/"):
+            return
+        if ctx.relpath in _SELF_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            if attr == "lap" and repo.phases:
+                yield from self._check_name(
+                    ctx, node, repo.phases, "phase", "solver/engine.py PHASES"
+                )
+            elif attr == "span" and repo.span_names:
+                yield from self._check_name(
+                    ctx, node, repo.span_names, "span",
+                    "obs/trace.py KNOWN_SPAN_NAMES",
+                )
+                yield from self._check_entered(ctx, node)
+            elif attr == "instant" and repo.instant_names:
+                yield from self._check_name(
+                    ctx, node, repo.instant_names, "instant",
+                    "obs/trace.py KNOWN_INSTANT_NAMES",
+                )
+
+    def _check_name(self, ctx, node, registry, kind, where) -> Iterator[Finding]:
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if not _name_ok(name, registry):
+                yield self.finding(
+                    ctx, node,
+                    f"{kind} name {name!r} is not in the registry ({where}): "
+                    "unknown names record into streams no consumer reads — "
+                    "add it to the registry or fix the typo",
+                )
+        elif isinstance(arg, ast.JoinedStr):
+            prefix = _fstring_prefix(arg)
+            if not prefix or not any(
+                entry.endswith(".*") and prefix.startswith(entry[:-1])
+                for entry in registry
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"computed {kind} name {ast.unparse(arg)} matches no "
+                    f"`prefix.*` registry entry ({where})",
+                )
+
+    def _check_entered(self, ctx, node) -> Iterator[Finding]:
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.withitem):
+            return
+        if isinstance(parent, ast.Return):
+            from tools.lint.core import enclosing_functions
+
+            inner = enclosing_functions(ctx, node)
+            if inner and inner[0].name.endswith("_span"):
+                return
+        yield self.finding(
+            ctx, node,
+            ".span(...) opened without `with`: the span never closes "
+            "(unmatched begin). Enter it in a with-statement, or return "
+            "it from a `*_span` factory the caller enters",
+        )

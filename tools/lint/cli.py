@@ -1,0 +1,137 @@
+"""doormanlint CLI: `python -m tools.lint`.
+
+Exit codes: 0 clean (every finding suppressed or baselined), 1 findings,
+2 usage / internal error. `--json` writes the machine-readable findings
+(CI uploads it as an artifact on failure); `--write-baseline` records
+the current unsuppressed findings as tolerated debt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.lint.core import (
+    apply_baseline,
+    default_checkers,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = "tools/lint/baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="doormanlint: repo-native contract checking (doc/lint.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/directories relative to the repo root "
+             "(default: doorman_tpu)",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="repo root (default: autodetected from this file's location)",
+    )
+    p.add_argument(
+        "--rule", action="append", dest="rules", metavar="NAME",
+        help="run only this rule (repeatable)",
+    )
+    p.add_argument(
+        "--json", dest="json_out", metavar="FILE",
+        help="write findings as JSON ('-' for stdout)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline file (default: {DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report all findings)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    p.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print allow[]-suppressed and baselined findings",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="list the rules and exit",
+    )
+    return p
+
+
+def detect_root(explicit: "str | None") -> Path:
+    if explicit:
+        return Path(explicit).resolve()
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for c in default_checkers():
+            print(f"{c.name}: {c.description}")
+        return 0
+    root = detect_root(args.root)
+    try:
+        findings = run_lint(root, paths=args.paths or None, rules=args.rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = root / (args.baseline or DEFAULT_BASELINE)
+    if args.write_baseline:
+        n = write_baseline(findings, baseline_path)
+        print(f"wrote {n} baseline entries to "
+              f"{baseline_path.relative_to(root)}")
+        return 0
+    if not args.no_baseline:
+        apply_baseline(findings, load_baseline(baseline_path))
+
+    active = [f for f in findings if not f.suppressed and not f.baselined]
+    shown = findings if args.show_suppressed else active
+    for f in shown:
+        tag = ""
+        if f.suppressed:
+            tag = " [suppressed]"
+        elif f.baselined:
+            tag = " [baselined]"
+        print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule}: {f.message}{tag}")
+
+    summary = {
+        "findings": len(active),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+    }
+    if args.json_out:
+        payload = json.dumps(
+            {
+                "version": 1,
+                "summary": summary,
+                "findings": [f.to_json() for f in findings],
+            },
+            indent=2,
+        )
+        if args.json_out == "-":
+            print(payload)
+        else:
+            Path(args.json_out).write_text(payload + "\n", encoding="utf-8")
+    print(
+        f"doormanlint: {summary['findings']} finding(s), "
+        f"{summary['suppressed']} suppressed, "
+        f"{summary['baselined']} baselined"
+    )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,427 @@
+"""doormanlint framework: file loading, suppressions, registries,
+baseline semantics, and the runner.
+
+Everything here is stdlib-only and purely syntactic (ast + comments):
+the linter never imports the code under analysis, so it runs in a bare
+CPU job with no jax present and cannot be confused by import-time side
+effects.
+
+Cross-file knowledge the checkers need — which classes are IntEnums,
+the engine's phase vocabulary, the obs span/instant registries, the
+fused-staging tracked-writer registry — is read from the scanned tree
+itself (`RepoContext`): the registries live next to the code they
+govern (solver/engine.py PHASES, obs/trace.py KNOWN_SPAN_NAMES, ...)
+and the linter picks up whatever literal the tree defines, so a test
+fixture tree carries its own registries the same way the real repo
+does.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# Same-line (or whole-preceding-line) suppression:
+#   something_flagged()  # doorman: allow[rule-name] optional reason
+#   # doorman: allow[rule-a,rule-b] reason
+#   covered_next_line()
+_ALLOW_RE = re.compile(r"#\s*doorman:\s*allow\[([a-zA-Z0-9_,\- *]+)\]")
+# Attribute / module-global lock declaration:  self.x = {}  # guarded-by: self._lock
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+# Function-level "caller holds the lock" annotation on the def line:
+#   def _locked_helper(self):  # holds-lock: self._lock
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+# Names whose top-level literal assignments feed RepoContext registries.
+_REGISTRY_NAMES = (
+    "PHASES",
+    "KNOWN_SPAN_NAMES",
+    "KNOWN_INSTANT_NAMES",
+    "FUSED_TRACKED_WRITERS",
+)
+
+_EXCLUDE_PARTS = {"__pycache__"}
+_EXCLUDE_FILES = {"doorman_pb2.py"}  # generated protobuf
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, POSIX separators
+    line: int
+    col: int
+    message: str
+    snippet: str = ""  # stripped source line — the baseline identity
+    suppressed: bool = False  # # doorman: allow[...] matched
+    baselined: bool = False  # matched a committed baseline entry
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with every edit, the
+        (rule, file, source-line-text) triple survives reflows."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+
+class FileContext:
+    """One parsed source file plus its comment-level annotations."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.allows: Dict[int, Set[str]] = self._scan_allows()
+
+    def _scan_allows(self) -> Dict[int, Set[str]]:
+        allows: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            target = i
+            if text.lstrip().startswith("#"):
+                # Standalone comment: covers the next source line.
+                target = i + 1
+            allows.setdefault(target, set()).update(rules)
+        return allows
+
+    def allowed(self, line: int, rule: str) -> bool:
+        rules = self.allows.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def text(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+    def guarded_marker(self, lineno: int) -> Optional[str]:
+        m = _GUARDED_RE.search(self.lines[lineno - 1]) if lineno <= len(self.lines) else None
+        return m.group(1) if m else None
+
+    def holds_marker(self, func: ast.AST) -> Optional[str]:
+        """`# holds-lock:` on the def line or the line just above it."""
+        for lineno in (func.lineno, func.lineno - 1):
+            if 1 <= lineno <= len(self.lines):
+                m = _HOLDS_RE.search(self.lines[lineno - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+
+def _literal_strings(node: ast.AST) -> Optional[Set[str]]:
+    """The set of string constants in a tuple/list/set literal or a
+    frozenset()/set() call wrapping one; None when not that shape."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and \
+            node.func.id in ("frozenset", "set") and len(node.args) == 1:
+        node = node.args[0]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class RepoContext:
+    """Cross-file knowledge: registries and type facts mined from the
+    scanned tree (never from imports)."""
+
+    def __init__(self, root: Path, files: Sequence[FileContext]):
+        self.root = root
+        self.files = list(files)
+        self.int_enum_classes: Set[str] = set()
+        self.phases: Set[str] = set()
+        self.span_names: Set[str] = set()
+        self.instant_names: Set[str] = set()
+        self.tracked_writers: Set[str] = set()
+        for ctx in self.files:
+            self._mine(ctx)
+
+    def _mine(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for base in node.bases:
+                    base_txt = ast.unparse(base)
+                    if base_txt in ("enum.IntEnum", "IntEnum"):
+                        self.int_enum_classes.add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name not in _REGISTRY_NAMES:
+                    continue
+                values = _literal_strings(node.value)
+                if values is None:
+                    continue
+                if name == "PHASES":
+                    self.phases.update(values)
+                elif name == "KNOWN_SPAN_NAMES":
+                    self.span_names.update(values)
+                elif name == "KNOWN_INSTANT_NAMES":
+                    self.instant_names.update(values)
+                elif name == "FUSED_TRACKED_WRITERS":
+                    self.tracked_writers.update(values)
+
+
+class Checker:
+    """One contract. Subclasses set `name`/`description` and implement
+    run(); findings they yield get suppression/baseline post-processing
+    from the runner."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.name,
+            path=ctx.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=ctx.line_text(line),
+        )
+
+
+def iter_source_files(root: Path, paths: Optional[Sequence[str]] = None) -> Iterator[Path]:
+    """Default target: the doorman_tpu package. Explicit paths may add
+    bench.py, tools, drives, ..."""
+    targets = [root / p for p in paths] if paths else [root / "doorman_tpu"]
+    for target in targets:
+        if target.is_file():
+            yield target
+            continue
+        for p in sorted(target.rglob("*.py")):
+            if _EXCLUDE_PARTS.intersection(p.parts) or p.name in _EXCLUDE_FILES:
+                continue
+            yield p
+
+
+def load_files(root: Path, paths: Optional[Sequence[str]] = None
+               ) -> Tuple[List[FileContext], List[Finding]]:
+    contexts: List[FileContext] = []
+    errors: List[Finding] = []
+    for p in iter_source_files(root, paths):
+        rel = p.relative_to(root).as_posix()
+        try:
+            source = p.read_text(encoding="utf-8")
+            contexts.append(FileContext(p, rel, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(Finding(
+                rule="parse-error", path=rel, line=getattr(e, "lineno", 1) or 1,
+                col=0, message=f"cannot analyze: {e}", snippet="",
+            ))
+    return contexts, errors
+
+
+def default_checkers() -> List[Checker]:
+    from tools.lint.checkers import ALL_CHECKERS
+
+    return [cls() for cls in ALL_CHECKERS]
+
+
+def run_lint(
+    root: Path,
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Iterable[str]] = None,
+    checkers: Optional[Sequence[Checker]] = None,
+) -> List[Finding]:
+    """Run the suite over `root`; returns every finding with its
+    `suppressed` flag resolved (baseline matching is the caller's
+    concern — see apply_baseline)."""
+    contexts, findings = load_files(root, paths)
+    repo = RepoContext(root, contexts)
+    active = list(checkers) if checkers is not None else default_checkers()
+    if rules:
+        wanted = set(rules)
+        unknown = wanted - {c.name for c in active}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        active = [c for c in active if c.name in wanted]
+    for checker in active:
+        for ctx in contexts:
+            for f in checker.run(ctx, repo):
+                f.suppressed = ctx.allowed(f.line, f.rule)
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Dict[Tuple[str, str, str], int]:
+    """Committed debt: counts per (rule, path, snippet) key. A missing
+    file is an empty baseline."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    out: Dict[Tuple[str, str, str], int] = {}
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry.get("snippet", ""))
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str, str], int]) -> None:
+    """Mark findings the baseline tolerates. Counted: a baseline entry
+    with count N absorbs at most N identical findings, so NEW copies of
+    an old sin still fail the gate."""
+    budget = dict(baseline)
+    for f in findings:
+        if f.suppressed:
+            continue
+        left = budget.get(f.key(), 0)
+        if left > 0:
+            budget[f.key()] = left - 1
+            f.baselined = True
+
+
+def write_baseline(findings: Iterable[Finding], path: Path) -> int:
+    """Write the unsuppressed findings as the new baseline; returns the
+    entry count. Suppressed findings are already handled in-source and
+    never belong in the baseline."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        if f.suppressed:
+            continue
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": rule, "path": p, "snippet": snippet, "count": n}
+        for (rule, p, snippet), n in sorted(counts.items())
+    ]
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        json.dumps({"version": 1, "findings": entries}, indent=2) + "\n",
+        encoding="utf-8",
+    )
+    return len(entries)
+
+
+# -- shared AST helpers used by several checkers -----------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('time.time', 'x.store.assign');
+    best effort, '' for computed targets."""
+    try:
+        return ast.unparse(node.func)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+def attr_tail(node: ast.Call) -> str:
+    """The final attribute of the call target ('assign' for
+    res.store.assign(...)), or the bare name for name calls."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def enclosing_functions(ctx: FileContext, node: ast.AST) -> List[ast.AST]:
+    """Innermost-first chain of FunctionDef/AsyncFunctionDef containing
+    `node`."""
+    out = []
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur)
+        cur = ctx.parents.get(cur)
+    return out
+
+
+def enclosing_class(ctx: FileContext, node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def qualname(ctx: FileContext, func: ast.AST) -> str:
+    """Class.method for methods, plain name otherwise (nested defs get
+    their outermost enclosing def's qualname suffixed)."""
+    names = [func.name]
+    cur = ctx.parents.get(func)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.append(cur.name)
+        cur = ctx.parents.get(cur)
+    return ".".join(reversed(names))
+
+
+@dataclass
+class WithLockMap:
+    """Per-function map from statement to the set of lock expressions
+    held at that statement (lexically, via `with <lock>:` blocks)."""
+
+    held_at: Dict[ast.AST, Set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, func: ast.AST) -> "WithLockMap":
+        m = cls()
+
+        def visit(node: ast.AST, held: Set[str]) -> None:
+            m.held_at[node] = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    try:
+                        inner.add(ast.unparse(item.context_expr))
+                    except Exception:  # pragma: no cover
+                        pass
+                for child in node.body:
+                    visit(child, inner)
+                for item in node.items:
+                    visit(item.context_expr, held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not func:
+                # Nested callables do not inherit the lexical lock: they
+                # may run later, on another thread.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, set())
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(func, set())
+        return m
+
+    def holds(self, node: ast.AST, lock: str) -> bool:
+        return lock in self.held_at.get(node, set())
