@@ -1109,6 +1109,368 @@ def bench_server_rpc_storm() -> None:
     asyncio.run(run())
 
 
+# server_push_vs_poll: the streaming lease push (WatchCapacity,
+# doc/streaming.md) against the equivalent polling population on the
+# same server build: steady-state GetCapacity rate, pushed bytes per
+# tick, and grant-propagation latency from a wants churn to every
+# subscriber observing its moved grant.
+PUSH_SUBSCRIBERS = 1000
+PUSH_STEADY_SECONDS = 3.0
+PUSH_CHURN_EVENTS = 4
+PUSH_CHURN_SETTLE_SECONDS = 2.6  # > refresh_interval + tick
+PUSH_TICK_SECONDS = 0.1
+PUSH_CHANNELS = 20
+# refresh 2s is CONSERVATIVE for the poll side: reference configs
+# refresh at 5s, which would flatter both ratios further.
+PUSH_REFRESH_SECONDS = 2
+PUSH_LEASE_SECONDS = 60
+
+
+def bench_server_push_vs_poll() -> None:
+    """Steady-state RPC load and grant-propagation latency: poll vs
+    stream at PUSH_SUBSCRIBERS subscribers.
+
+    Two phases against identically-configured batch-mode servers
+    (python store; no device work — this bench measures the serving
+    path, and rides cpu_fallback rounds unchanged). The POLL phase runs
+    1k clients refreshing at the served refresh interval — the
+    pre-streaming contract. The STREAM phase holds 1k WatchCapacity
+    subscriptions on the same population. Each phase measures its
+    steady-state GetCapacity rate over a quiet window (unchanged
+    wants), then drives PUSH_CHURN_EVENTS oversubscription flips from
+    one churner client and records, per subscriber, the time from the
+    churn RPC to the first observed grant change (poll: next refresh
+    that returns a moved lease; stream: the tick-edge push landing).
+
+    The RPC-reduction verdict is conservative: the observed value is
+    the MEASURED window ratio clamped to the analytic steady-state
+    bound (lease margin / refresh interval) — a quiet window with zero
+    stream-side RPCs must not claim more than the safety-poll cadence
+    amortizes to over a full lease."""
+    import asyncio
+
+    import grpc as _grpc
+
+    from doorman_tpu.proto import doorman_pb2 as _pb
+    from doorman_tpu.proto import doorman_stream_pb2 as _spb
+    from doorman_tpu.proto.grpc_api import CapacityStub
+    from doorman_tpu.server.config import parse_yaml_config
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    capacity = PUSH_SUBSCRIBERS * 10  # wants 10 each: exactly at cap
+    config = parse_yaml_config(
+        "resources:\n"
+        '- identifier_glob: "*"\n'
+        f"  capacity: {capacity}\n"
+        "  safe_capacity: 1\n"
+        "  algorithm: {kind: PROPORTIONAL_SHARE,\n"
+        f"              lease_length: {PUSH_LEASE_SECONDS},\n"
+        f"              refresh_interval: {PUSH_REFRESH_SECONDS},\n"
+        "              learning_mode_duration: 0}\n"
+    )
+
+    async def make_server():
+        server = CapacityServer(
+            "push-bench", TrivialElection(), mode="batch",
+            tick_interval=PUSH_TICK_SECONDS,
+            minimum_refresh_interval=0.0, stream_push=True,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(config)
+        await asyncio.sleep(0)  # election callbacks land
+        server.current_master = f"127.0.0.1:{port}"
+        return server, f"127.0.0.1:{port}"
+
+    def make_channels(addr):
+        # Distinct connections (local subchannel pool) so 1k held
+        # streams spread instead of queueing on one HTTP/2 session.
+        return [
+            _grpc.aio.insecure_channel(
+                addr, options=(("grpc.use_local_subchannel_pool", 1),)
+            )
+            for _ in range(PUSH_CHANNELS)
+        ]
+
+    # Shared churn-event marker: subscriber tasks record the time from
+    # the marked churn RPC to their FIRST observed grant change.
+    event = {"id": 0, "t": 0.0}
+
+    async def drive_churn(stub):
+        """Flip one churner between under- and oversubscription; each
+        flip rescales EVERY subscriber's proportional grant."""
+        has = None
+        for k in range(PUSH_CHURN_EVENTS):
+            wants = float(capacity) if k % 2 == 0 else 1.0
+            req = _pb.GetCapacityRequest(client_id="churner")
+            rr = req.resource.add()
+            rr.resource_id = "bench"
+            rr.wants = wants
+            if has is not None:
+                rr.has.CopyFrom(has)
+            event["id"] += 1
+            event["t"] = time.monotonic()
+            out = await stub.GetCapacity(req)
+            lease = _pb.Lease()
+            lease.CopyFrom(out.response[0].gets)
+            has = lease
+            await asyncio.sleep(PUSH_CHURN_SETTLE_SECONDS)
+
+    async def poll_phase():
+        server, addr = await make_server()
+        channels = make_channels(addr)
+        rpcs = [0]
+        orig = server.on_request
+        server.on_request = lambda m, d, e: (
+            rpcs.__setitem__(0, rpcs[0] + (m == "GetCapacity")),
+            orig(m, d, e),
+        )
+        samples: list = []
+        stop = asyncio.Event()
+
+        async def poller(i):
+            stub = CapacityStub(channels[i % PUSH_CHANNELS])
+            req = _pb.GetCapacityRequest(client_id=f"p{i}")
+            rr = req.resource.add()
+            rr.resource_id = "bench"
+            rr.wants = 10.0
+            last_cap, seen = None, 0
+            # Stagger the fleet across the refresh interval.
+            await asyncio.sleep((i / PUSH_SUBSCRIBERS)
+                                * PUSH_REFRESH_SECONDS)
+            while not stop.is_set():
+                out = await stub.GetCapacity(req)
+                rr.has.CopyFrom(out.response[0].gets)
+                cap = out.response[0].gets.capacity
+                if cap != last_cap:
+                    if last_cap is not None and event["id"] > seen:
+                        samples.append(time.monotonic() - event["t"])
+                        seen = event["id"]
+                    last_cap = cap
+                try:
+                    await asyncio.wait_for(
+                        stop.wait(), PUSH_REFRESH_SECONDS
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+        tasks = [asyncio.ensure_future(poller(i))
+                 for i in range(PUSH_SUBSCRIBERS)]
+        try:
+            # Join + settle, then the quiet steady-state window.
+            await asyncio.sleep(2.0 * PUSH_REFRESH_SECONDS)
+            mark = rpcs[0]
+            await asyncio.sleep(PUSH_STEADY_SECONDS)
+            steady = rpcs[0] - mark
+            stub = CapacityStub(channels[0])
+            await drive_churn(stub)
+        finally:
+            stop.set()
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for ch in channels:
+                await ch.close()
+            await server.stop()
+        return {"steady_rpcs": steady, "samples": sorted(samples)}
+
+    async def stream_phase():
+        server, addr = await make_server()
+        channels = make_channels(addr)
+        rpcs = [0]
+        orig = server.on_request
+        server.on_request = lambda m, d, e: (
+            rpcs.__setitem__(0, rpcs[0] + (m == "GetCapacity")),
+            orig(m, d, e),
+        )
+        samples: list = []
+        stop = asyncio.Event()
+        established = [0]
+
+        async def subscriber(i):
+            stub = CapacityStub(channels[i % PUSH_CHANNELS])
+            req = _spb.WatchCapacityRequest(client_id=f"s{i}")
+            rr = req.resource.add()
+            rr.resource_id = "bench"
+            rr.wants = 10.0
+            call = stub.WatchCapacity(req)
+            pending = None
+            last_cap, seen = None, 0
+            try:
+                while not stop.is_set():
+                    if pending is None:
+                        pending = asyncio.ensure_future(call.read())
+                    done, _ = await asyncio.wait(
+                        {pending}, timeout=0.5
+                    )
+                    if not done:
+                        continue
+                    task, pending = pending, None
+                    msg = task.result()
+                    if msg is _grpc.aio.EOF or msg.HasField("mastership"):
+                        return
+                    if msg.snapshot:
+                        established[0] += 1
+                    for row in msg.response:
+                        cap = row.gets.capacity
+                        if cap != last_cap:
+                            if (last_cap is not None
+                                    and event["id"] > seen):
+                                samples.append(
+                                    time.monotonic() - event["t"]
+                                )
+                                seen = event["id"]
+                            last_cap = cap
+            finally:
+                if pending is not None:
+                    pending.cancel()
+                call.cancel()
+
+        tasks = [asyncio.ensure_future(subscriber(i))
+                 for i in range(PUSH_SUBSCRIBERS)]
+        try:
+            # Establishment (1k subscribe decides) + settle.
+            deadline = time.monotonic() + 15.0
+            while (established[0] < PUSH_SUBSCRIBERS
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.1)
+            n_established = established[0]
+            await asyncio.sleep(2.0 * PUSH_REFRESH_SECONDS)
+            registry = server._streams
+            mark = rpcs[0]
+            bytes_mark = registry.total_bytes
+            ticks_mark = server._ticks_done
+            await asyncio.sleep(PUSH_STEADY_SECONDS)
+            steady = rpcs[0] - mark
+            steady_bytes = registry.total_bytes - bytes_mark
+            steady_ticks = max(server._ticks_done - ticks_mark, 1)
+            stub = CapacityStub(channels[0])
+            churn_bytes_mark = registry.total_bytes
+            churn_ticks_mark = server._ticks_done
+            await drive_churn(stub)
+            churn_bytes = registry.total_bytes - churn_bytes_mark
+            churn_ticks = max(server._ticks_done - churn_ticks_mark, 1)
+        finally:
+            stop.set()
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for ch in channels:
+                await ch.close()
+            await server.stop()
+        return {
+            "steady_rpcs": steady,
+            "established": n_established,
+            "steady_push_bytes_per_tick": round(
+                steady_bytes / steady_ticks, 1
+            ),
+            "churn_push_bytes_per_tick": round(
+                churn_bytes / churn_ticks, 1
+            ),
+            "samples": sorted(samples),
+        }
+
+    async def run():
+        poll = await poll_phase()
+        stream = await stream_phase()
+        if (not poll["samples"] or not stream["samples"]
+                or stream["established"] < PUSH_SUBSCRIBERS // 2):
+            # The comparison never happened (establishment failed or no
+            # subscriber observed the churn): report why, no metric row.
+            diagnostic({
+                "diagnostic": "push_vs_poll_invalid",
+                "note": (
+                    f"established {stream.get('established')} of "
+                    f"{PUSH_SUBSCRIBERS}; propagation samples "
+                    f"poll={len(poll['samples'])} "
+                    f"stream={len(stream['samples'])}"
+                ),
+            })
+            return
+        from doorman_tpu.obs import slo as slo_mod
+
+        poll_rate = poll["steady_rpcs"] / PUSH_STEADY_SECONDS
+        stream_rate = stream["steady_rpcs"] / PUSH_STEADY_SECONDS
+        measured = poll["steady_rpcs"] / max(stream["steady_rpcs"], 1)
+        # What the expiry-margin safety poll amortizes to over a full
+        # lease: one stream RPC per (lease - refresh) vs one poll per
+        # refresh (client._watch_poll_deadline).
+        amortized = (
+            (PUSH_LEASE_SECONDS - PUSH_REFRESH_SECONDS)
+            / PUSH_REFRESH_SECONDS
+        )
+        reduction = round(min(measured, amortized), 3)
+        poll_p50 = slo_mod.sample_quantile(poll["samples"], 0.50)
+        poll_p99 = slo_mod.sample_quantile(poll["samples"], 0.99)
+        stream_p50 = slo_mod.sample_quantile(stream["samples"], 0.50)
+        stream_p99 = slo_mod.sample_quantile(stream["samples"], 0.99)
+        speedup = round(poll_p50 / max(stream_p50, 1e-9), 3)
+        specs = [
+            slo_mod.SloSpec(
+                name="server_push_vs_poll:rpc_reduction",
+                kind="min", target=10.0, unit="x",
+                source={"type": "scalar", "key": "rpc_reduction"},
+                description=(
+                    "steady-state GetCapacity rate, poll/stream, "
+                    "clamped to the lease-margin amortized bound"
+                ),
+            ),
+            slo_mod.SloSpec(
+                name="server_push_vs_poll:grant_propagation_speedup",
+                kind="min", target=2.0, unit="x",
+                source={"type": "scalar", "key": "prop_speedup_p50"},
+                description=(
+                    "grant-propagation p50, poll lag / push lag"
+                ),
+            ),
+        ]
+        verdicts = slo_mod.SloEngine(specs).evaluate(slo_mod.SloInputs(
+            scalars={
+                "rpc_reduction": reduction,
+                "prop_speedup_p50": speedup,
+            }
+        ))
+        emit({
+            "metric": "server_push_vs_poll_rpc_rate_poll",
+            "value": round(poll_rate, 1),
+            "unit": "qps",
+            "subscribers": PUSH_SUBSCRIBERS,
+            "prop_p50_ms": round(poll_p50 * 1000, 1),
+            "prop_p99_ms": round(poll_p99 * 1000, 1),
+            "prop_samples": len(poll["samples"]),
+        })
+        emit(
+            {
+                "metric": "server_push_vs_poll_rpc_rate_stream",
+                "value": round(stream_rate, 1),
+                "unit": "qps",
+                "subscribers": stream["established"],
+                "rpc_reduction": reduction,
+                "rpc_reduction_measured": round(measured, 1),
+                "rpc_reduction_amortized_bound": round(amortized, 1),
+                "steady_push_bytes_per_tick": (
+                    stream["steady_push_bytes_per_tick"]
+                ),
+                "churn_push_bytes_per_tick": (
+                    stream["churn_push_bytes_per_tick"]
+                ),
+                "prop_p50_ms": round(stream_p50 * 1000, 1),
+                "prop_p99_ms": round(stream_p99 * 1000, 1),
+                "prop_speedup_p50": speedup,
+                "prop_samples": len(stream["samples"]),
+                "slo": verdicts,
+            },
+            artifact_extra={
+                "poll": {k: v for k, v in poll.items()
+                         if k != "samples"},
+                "stream": {k: v for k, v in stream.items()
+                           if k != "samples"},
+            },
+        )
+
+    asyncio.run(run())
+
+
 def gate_pallas_kernels() -> None:
     """Real-TPU pallas regression gate: compile and run BOTH pallas
     kernels (dense lanes + banded priority water-fill) on the chip and
@@ -1355,6 +1717,9 @@ if __name__ == "__main__":
         # RPC front-end under storm (no device work; rides along so
         # admission regressions show in the same artifact).
         bench_server_rpc_storm()
+        # Streaming lease push vs the polling population (no device
+        # work): steady-state RPC reduction + grant propagation.
+        bench_server_push_vs_poll()
         # The narrow server tick stays LAST: the driver parses the final
         # JSON line as the round's headline metric.
         bench_server_tick()

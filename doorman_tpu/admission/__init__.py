@@ -174,6 +174,32 @@ class Admission:
             kind="overload",
         )
 
+    def check_watch(self, request) -> Optional[Shed]:
+        """Admission gate for WatchCapacity stream ESTABLISHMENT: the
+        same AIMD band-ordered shed as a refresh (lowest bands
+        extinguish first, the top band never while lower bands exist).
+        No deadline fast-fail — a stream has no per-RPC deadline to
+        protect. The per-band stream cap is enforced by the server's
+        StreamRegistry (it owns the live counts) AFTER this gate, so a
+        capped band still consumes an admit draw — establishment
+        attempts are offered load like any other."""
+        band = max((rr.priority for rr in request.resource), default=0)
+        admitted, retry_after = self.controller.admit(band)
+        if admitted:
+            self._tally("WatchCapacity", band, "admitted")
+            return None
+        self._tally("WatchCapacity", band, "shed")
+        return Shed(
+            reason=(
+                f"overload: stream establishment for band {band} shed "
+                f"at admit level {self.controller.level:.3f}; retry "
+                f"after {retry_after:.3f}s"
+            ),
+            retry_after=retry_after,
+            band=band,
+            kind="overload",
+        )
+
     def note_pass_through(self, method: str, band: int = 0) -> None:
         """Tally a never-shed method (the shed matrix's 'never' rows);
         these do not consume controller admit draws — they are load the

@@ -8,6 +8,14 @@ What may be shed, and what a shed looks like on the wire:
                                   leases outlive a missed refresh, so a
                                   shed client keeps serving on its last
                                   grant and simply comes back later
+  WatchCapacity        by band    stream ESTABLISHMENT only (an open
+                                  stream is never shed mid-flight — it
+                                  costs the server nothing until a row
+                                  moves): a refused subscriber simply
+                                  keeps polling, which is the exact
+                                  contract it would have without the
+                                  stream; per-band stream caps shed
+                                  here too (kind="stream_cap")
   GetServerCapacity    never      one RPC aggregates a whole downstream
                                   subtree; shedding it degrades every
                                   client under that server at once
@@ -38,6 +46,7 @@ RETRY_AFTER_KEY = "doorman-retry-after"
 # method -> may the admission controller shed it?
 SHED_MATRIX = {
     "GetCapacity": True,
+    "WatchCapacity": True,  # establishment only; see the table above
     "GetServerCapacity": False,
     "ReleaseCapacity": False,
     "Discovery": False,
@@ -55,4 +64,4 @@ class Shed:
     reason: str
     retry_after: float
     band: int
-    kind: str  # "overload" | "deadline"
+    kind: str  # "overload" | "deadline" | "stream_cap"

@@ -272,6 +272,36 @@ class ChaosGrpcProxy(CapacityServicer):
     async def ReleaseCapacity(self, request, context):
         return await self._intercept("ReleaseCapacity", request, context)
 
+    async def WatchCapacity(self, request, context):
+        """The server-streaming leg of the proxy: establishment walks
+        the same fault seams as a unary RPC (grpc_not_master yields a
+        terminal redirect — exactly what a flipped master streams),
+        then every backend push is forwarded message for message."""
+        import grpc
+
+        p = self._state.take("grpc_delay", self.link)
+        if p is not None:
+            await asyncio.sleep(float(p.get("seconds", 0.01)))
+        p = self._state.take("grpc_drop", self.link)
+        if p is not None:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"chaos: rpc dropped ({self.link})",
+            )
+        p = self._state.take("grpc_not_master", self.link)
+        if p is not None:
+            from doorman_tpu.proto import doorman_stream_pb2 as spb
+
+            out = spb.WatchCapacityResponse()
+            if p.get("master"):
+                out.mastership.master_address = p["master"]
+            else:
+                out.mastership.SetInParent()
+            yield out
+            return
+        async for msg in self.backend.WatchCapacity(request, context):
+            yield msg
+
 
 # ----------------------------------------------------------------------
 # Solver / backend seam

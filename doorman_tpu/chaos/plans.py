@@ -18,7 +18,12 @@ def master_flap() -> FaultPlan:
     """Two candidates; the master's etcd view browns out past the lock
     TTL. Expect: step-down without split-brain, the standby wins after
     the lock lapses, clients chase the redirect once the old master's
-    watcher heals, allocation returns to baseline via learning mode."""
+    watcher heals, allocation returns to baseline via learning mode.
+    The streaming leg: one WatchCapacity subscriber rides along — its
+    stream must terminate with a mastership redirect at the flip, the
+    client must fall back to polling (the lease-window invariants hold
+    for it like any polling client), and it must re-establish a stream
+    once a master is back."""
     return FaultPlan(
         name="master_flap",
         seed=1,
@@ -26,6 +31,10 @@ def master_flap() -> FaultPlan:
             "servers": 2,
             "clients": 3,
             "wants": [20.0, 30.0, 60.0],
+            # The streaming leg (runner: stream_step per tick; servers
+            # get stream_push + a per-tick fanout beat).
+            "streams": 1,
+            "stream_wants": [15.0],
             "capacity": 100,
             "mode": "immediate",
             "lease_length": 60,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import random
 from typing import Dict, List, Optional
 
 from doorman_tpu.chaos.clock import ChaosClock
@@ -145,6 +146,12 @@ class ChaosRunner:
         self.proxies: Dict[str, ChaosGrpcProxy] = {}
         self.elections: Dict[str, SteppedElection] = {}
         self.clients: List[Client] = []
+        # Streaming leg (setup["streams"]): WatchCapacity subscribers
+        # stepped deterministically each tick (stream_step: drain the
+        # pushes already in flight, poll fallback when the stream is
+        # down or silent); they ride every invariant check but stay out
+        # of the baseline/convergence snapshots like the storm swarm.
+        self.stream_clients: List[Client] = []
         # Storm swarm (client_storm events): created when the storm
         # arms, refreshed every storm tick AFTER the base clients,
         # closed (releasing) when it clears.
@@ -262,6 +269,9 @@ class ChaosRunner:
                 native_store=bool(s.get("native_store", False)),
                 persist=persist,
                 admission=admission,
+                # Streaming leg: every candidate serves WatchCapacity
+                # (the runner drives the fanout beat explicitly).
+                stream_push=bool(s.get("streams")),
             )
             SolverInjector(self.state, name).install(server)
             await server.start(0, host="127.0.0.1")
@@ -313,9 +323,21 @@ class ChaosRunner:
             )
             await client.resource(RESOURCE, float(w), priority=int(p))
             self.clients.append(client)
+        stream_wants = s.get("stream_wants") or [
+            10.0 for _ in range(int(s.get("streams", 0)))
+        ]
+        for i, w in enumerate(stream_wants[: int(s.get("streams", 0))]):
+            # Seeded retry jitter: shed/backoff pacing replays exactly.
+            client = Client(
+                attach, f"w{i}", minimum_refresh_interval=0.0,
+                max_retries=0, clock=self.clock, stream=True,
+                retry_rng=random.Random(self.plan.seed * 1000 + i),
+            )
+            await client.resource(RESOURCE, float(w))
+            self.stream_clients.append(client)
 
     async def _teardown(self) -> None:
-        for client in self.clients + self.storm_clients:
+        for client in self.clients + self.stream_clients + self.storm_clients:
             try:
                 await client.close()
             except Exception:
@@ -398,6 +420,28 @@ class ChaosRunner:
                 await client.close()
             self.log.append([tick, "storm_end", len(swarm)])
 
+    async def _drive_streams(self, tick: int) -> None:
+        """The streaming leg's per-tick beat: the master fans out lease
+        deltas at the tick edge (the runner owns the cadence — server
+        background loops are cancelled), then each stream client takes
+        one deterministic stream_step (drain pushes, chase redirects,
+        fall back to a poll while the stream is down or silent). One
+        event-log entry per client per tick where anything happened, so
+        the flap's terminate→redirect→poll→re-establish arc is pinned
+        byte-for-byte by the determinism check."""
+        if not self.stream_clients:
+            return
+        for server in self.servers.values():
+            server.push_streams()
+        for client in self.stream_clients:
+            out = await client.stream_step(drain_timeout=0.05)
+            if out["events"] or out["pushes"]:
+                self.log.append([
+                    tick, "stream", client.id,
+                    ",".join(out["events"]) or "push",
+                    out["pushes"],
+                ])
+
     def _log_admission(self, tick: int) -> None:
         """One deterministic event-log entry per server per tick where
         admission activity moved: GetCapacity admitted/shed deltas plus
@@ -456,8 +500,23 @@ class ChaosRunner:
                 }
             if server._persist is not None:
                 persist_seq[name] = server._persist.journal.seq
+        streams = {}
+        for name, server in sorted(self.servers.items()):
+            if server._streams is not None:
+                # Per-tick stream-push load (registry counters reset on
+                # read; chaos servers never run tick_once's recorder,
+                # so this is the only consumer): deterministic ints —
+                # message bytes are protobuf-serialized plan state.
+                st = server._streams.take_tick_stats()
+                streams[name] = {
+                    "subscribers": st["subscribers"],
+                    "deltas_pushed": st["deltas_pushed"],
+                    "push_bytes": st["push_bytes"],
+                }
         if admission:
             rec["admission"] = admission
+        if streams:
+            rec["streams"] = streams
         if persist_seq:
             rec["persist_seq"] = persist_seq
         if violations:
@@ -584,6 +643,7 @@ class ChaosRunner:
                 for client in self.clients:
                     await client.refresh_once()
 
+                await self._drive_streams(tick)
                 await self._drive_storm(tick)
                 self._log_admission(tick)
 
@@ -596,11 +656,13 @@ class ChaosRunner:
 
                 tick_violations = checker.check_tick(
                     tick, self.servers, groups,
-                    # Active storm clients are checked too: an admitted
-                    # storm lease is subject to lag-never-lead like any
-                    # other (baseline/convergence snapshots stay on the
-                    # base population only).
-                    self.clients + self.storm_clients,
+                    # Active storm and stream clients are checked too:
+                    # an admitted storm lease — or a pushed stream
+                    # lease — is subject to lag-never-lead and the
+                    # lease window like any other (baseline/convergence
+                    # snapshots stay on the base population only).
+                    self.clients + self.stream_clients
+                    + self.storm_clients,
                 )
                 for v in tick_violations:
                     self._record_violation(v)

@@ -25,6 +25,7 @@ from doorman_tpu.admission.policy import RETRY_AFTER_KEY
 from doorman_tpu.client.connection import Connection
 from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto import doorman_stream_pb2 as spb
 from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
 
 log = logging.getLogger(__name__)
@@ -34,6 +35,11 @@ CAPACITY_QUEUE_SIZE = 32
 # Upper bound on one bulk-refresh RPC attempt (including the
 # connection's internal redirect/retry chasing); see _perform_requests.
 REFRESH_RPC_BOUND = 30.0
+
+# Stream mode: after the server answered UNIMPLEMENTED (stream push
+# disabled there), poll for this long before probing the stream again —
+# a flip may land on a master that does stream.
+STREAM_REPROBE = 60.0
 
 _id_counter = 0
 
@@ -118,13 +124,18 @@ class Client:
         max_retries: Optional[int] = None,
         clock: Callable[[], float] = time.time,
         retry_rng: Optional[random.Random] = None,
+        stream: bool = False,
     ):
         """`max_retries` bounds each RPC's internal retry loop (None =
         the reference's retry-forever). `clock` is the wall-clock used
         for lease-expiry decisions; the chaos harness injects a virtual
         clock here so outage expiry is deterministic. `retry_rng` is the
         matching randomness seam: pass a seeded random.Random to pin the
-        retry/shed jitter in replayed runs."""
+        retry/shed jitter in replayed runs. `stream=True` holds one
+        WatchCapacity stream instead of polling — lease deltas arrive
+        as tick-edge pushes, with automatic poll fallback whenever the
+        stream is shed, unsupported, redirected, or quiet into the
+        lease-expiry margin (doc/streaming.md)."""
         self.id = client_id or _default_client_id()
         self._clock = clock
         self.conn = Connection(
@@ -144,6 +155,19 @@ class Client:
         # else's draws interleave with it; unseeded only when the
         # caller injected nothing (production).
         self._retry_rng = retry_rng if retry_rng is not None else random.Random()
+        # Stream mode (WatchCapacity push): the last applied push seq
+        # (offered back as resume_seq on reconnect), the next time a
+        # stream establishment may be attempted, and its backoff rung.
+        # The poll path stays fully functional and is the fallback.
+        self._stream = bool(stream)
+        self._watch_seq = 0
+        self._stream_retry_at = 0.0
+        self._stream_retry_n = 0
+        # Stepped-harness stream state (stream_step; the background
+        # task keeps its own call/read locals instead).
+        self._watch_call = None
+        self._watch_pending: Optional[asyncio.Task] = None
+        self._watch_last = 0.0
         # Metrics hook (method, duration_s, error); the obs module's
         # instrument_client replaces this (reference client.go:87-99).
         self.on_request: Callable[[str, float, bool], None] = lambda *a: None
@@ -204,6 +228,16 @@ class Client:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # Stepped-mode stream state (the background task cleans its own).
+        if self._watch_pending is not None:
+            self._watch_pending.cancel()
+            self._watch_pending = None
+        if self._watch_call is not None:
+            try:
+                self._watch_call.cancel()
+            except Exception:
+                pass
+            self._watch_call = None
         if self.resources:
             try:
                 await asyncio.wait_for(
@@ -241,7 +275,11 @@ class Client:
     async def _run(self) -> None:
         """Main loop: wake on a new resource or when the shortest refresh
         interval elapses; refresh everything in one bulk RPC
-        (client.go:227-294)."""
+        (client.go:227-294). In stream mode the loop instead holds a
+        WatchCapacity stream for as long as one is healthy (no RPCs at
+        steady state — deltas are pushed), and each time the stream
+        ends it degrades to this same poll loop until the next
+        establishment attempt is due (_stream_retry_at)."""
         interval, retry = 0.0, 0
         while not self._closed:
             try:
@@ -252,6 +290,13 @@ class Client:
             if not self.resources:
                 interval = VERY_LONG_TIME
                 continue
+            if self._stream and self._clock() >= self._stream_retry_at:
+                await self._watch_cycle()
+                if self._closed:
+                    break
+                # The stream ended (shed / redirect / unsupported /
+                # error): one poll keeps leases fresh and chases any
+                # redirect, then the loop retries the stream when due.
             interval, retry = await self._perform_requests(retry)
 
     async def refresh_once(self) -> bool:
@@ -413,3 +458,307 @@ class Client:
                 interval = min(interval, float(res.lease.refresh_interval))
         interval = max(interval, self.conn.minimum_refresh_interval)
         return interval, 0
+
+    # ------------------------------------------------------------------
+    # Stream mode (WatchCapacity push; doc/streaming.md)
+    # ------------------------------------------------------------------
+
+    def _watch_request(self) -> spb.WatchCapacityRequest:
+        """The subscription request: every claimed resource, with the
+        current lease as the resume baseline and the last applied push
+        seq as the resume token."""
+        request = spb.WatchCapacityRequest(
+            client_id=self.id, resume_seq=self._watch_seq
+        )
+        for resource_id, res in self.resources.items():
+            rr = request.resource.add()
+            rr.resource_id = resource_id
+            rr.priority = res.priority
+            rr.wants = res.wants
+            if res.lease is not None:
+                rr.has.CopyFrom(res.lease)
+        return request
+
+    def _watch_poll_deadline(self) -> float:
+        """Absolute time of the next safety poll on a quiet stream: one
+        refresh interval BEFORE the earliest local lease expiry — the
+        staleness margin a polling client lives with at its poll
+        instant. Pushes carry a fresh expiry for every row they touch
+        and the master's silent-refresh beat keeps renewing the lease
+        server-side, so a healthy-but-quiet stream costs ~1 RPC per
+        lease length instead of one per refresh interval — the
+        steady-state RPC reduction streaming exists for. A stream that
+        dies without an error (half-open TCP, wedged master) hits the
+        same deadline and degrades to a poll before the lease lapses."""
+        now = self._clock()
+        deadline = float("inf")
+        for res in self.resources.values():
+            if res.lease is None:
+                # No lease landed yet: nothing protects this line but
+                # polling; don't trust stream silence for it.
+                return now
+            deadline = min(
+                deadline,
+                float(res.lease.expiry_time)
+                - max(float(res.lease.refresh_interval), 1.0),
+            )
+        if deadline == float("inf"):
+            return now
+        # Floor: never poll-spin when a served lease is already inside
+        # its margin (e.g. very short lease lengths).
+        return max(
+            deadline,
+            self._watch_last
+            + max(self.conn.minimum_refresh_interval, 0.1),
+        )
+
+    def _watch_apply(self, msg) -> str:
+        """Apply one pushed message; returns "redirect" (terminal),
+        "stale" (seq replay — dropped), or "applied". Row application
+        is field-for-field the poll response path."""
+        if msg.HasField("mastership"):
+            return "redirect"
+        if msg.seq and msg.seq <= self._watch_seq and not msg.snapshot:
+            # Exactly-once: a replayed or reordered push is dropped (a
+            # stream is a single in-order writer, so this only fires
+            # across reconnects).
+            return "stale"
+        if msg.snapshot:
+            # Every stream opens with a snapshot: REBASE onto this
+            # master's seq axis (a flip may land on a master whose
+            # counter restarted below our high-water mark).
+            self._watch_seq = int(msg.seq)
+        else:
+            self._watch_seq = max(self._watch_seq, int(msg.seq))
+        self._watch_last = self._clock()
+        for pr in msg.response:
+            res = self.resources.get(pr.resource_id)
+            if res is None:
+                log.error(
+                    "%s: push for unclaimed resource %r",
+                    self.id, pr.resource_id,
+                )
+                continue
+            old_capacity = (
+                res.lease.capacity if res.lease is not None else -1.0
+            )
+            if pr.HasField("safe_capacity"):
+                res.safe_capacity = pr.safe_capacity
+            else:
+                res.safe_capacity = None
+            res.lease = pb.Lease()
+            res.lease.CopyFrom(pr.gets)
+            res._fallback_capacity = 0.0  # live lease again
+            if res.lease.capacity != old_capacity:
+                res._push_capacity(res.lease.capacity)
+        return "applied"
+
+    def _watch_fail_backoff(self) -> None:
+        self._stream_retry_at = self._clock() + backoff(
+            MIN_BACKOFF, MAX_BACKOFF, self._stream_retry_n,
+            jitter=self._retry_rng,
+        )
+        self._stream_retry_n += 1
+
+    def _watch_error(self, e: "grpc.aio.AioRpcError") -> str:
+        """Classify a stream error into the next retry policy; returns
+        an event tag (stepped harnesses log it)."""
+        code = e.code()
+        if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+            # Admission shed the establishment (AIMD band shed or the
+            # per-band stream cap); honor the retry-after hint with
+            # half jitter exactly like a shed poll.
+            hint = self._retry_after_hint(e)
+            self._stream_retry_at = (
+                self._clock()
+                + 0.5 * hint
+                + self._retry_rng.uniform(0.0, 0.5 * hint)
+            )
+            self._stream_retry_n += 1
+            log.warning(
+                "%s: capacity stream shed; retrying in ~%.1fs",
+                self.id, hint,
+            )
+            return "shed"
+        if code == grpc.StatusCode.UNIMPLEMENTED:
+            self._stream_retry_at = self._clock() + STREAM_REPROBE
+            log.info(
+                "%s: server does not stream; polling (re-probe in %.0fs)",
+                self.id, STREAM_REPROBE,
+            )
+            return "unimplemented"
+        log.warning("%s: capacity stream failed (%s)", self.id, code)
+        self._watch_fail_backoff()
+        return "error"
+
+    async def _watch_redirect(self, msg) -> None:
+        """Terminal mastership message: chase the indicated master (the
+        caller's fallback poll re-validates it before the stream is
+        re-established)."""
+        addr = msg.mastership.master_address
+        if addr:
+            try:
+                await self.conn.redirect(addr)
+            except Exception:
+                log.warning(
+                    "%s: redirect to %s failed", self.id, addr,
+                )
+            self._stream_retry_at = self._clock()
+            self._stream_retry_n = 0
+        else:
+            # Master unknown: back off like a failed poll would.
+            self._watch_fail_backoff()
+
+    async def _watch_cycle(self) -> None:
+        """One WatchCapacity stream session (background stream mode):
+        establish, apply pushes as they arrive, degrade to one poll
+        whenever the stream is silent past the refresh interval, and
+        return when the stream ends — the caller polls once and retries
+        establishment per _stream_retry_at."""
+        try:
+            await self.conn.ensure()
+        except Exception:
+            log.warning("%s: dial for capacity stream failed", self.id)
+            self._watch_fail_backoff()
+            return
+        with trace_mod.default_tracer().span(
+            "client.WatchCapacity", cat="client",
+            args={"client": self.id, "resources": len(self.resources)},
+        ):
+            call = self.conn.stub.WatchCapacity(
+                self._watch_request(), metadata=trace_mod.grpc_metadata()
+            )
+        pending: Optional[asyncio.Task] = None
+        wake_task: Optional[asyncio.Task] = None
+        try:
+            while not self._closed:
+                if pending is None:
+                    pending = asyncio.ensure_future(call.read())
+                if wake_task is None:
+                    wake_task = asyncio.ensure_future(self._wake.wait())
+                done, _ = await asyncio.wait(
+                    {pending, wake_task},
+                    timeout=max(
+                        0.1, self._watch_poll_deadline() - self._clock()
+                    ),
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if wake_task in done:
+                    # ask() / new resource: the subscription lines are
+                    # stale — resubscribe immediately (the caller's
+                    # poll ships the new wants first).
+                    self._stream_retry_at = self._clock()
+                    self._stream_retry_n = 0
+                    return
+                if pending not in done:
+                    if self._clock() >= self._watch_poll_deadline():
+                        # Quiet into the lease-expiry margin: ONE
+                        # safety poll. A healthy stream stays open
+                        # through it; a failed poll runs the usual
+                        # expiry fallback.
+                        self._watch_last = self._clock()
+                        await self._perform_requests(0)
+                    continue
+                msg = pending.result()  # raises on stream errors
+                pending = None
+                if msg is grpc.aio.EOF:
+                    # Server closed without a terminal message (e.g.
+                    # shutdown); re-establish after a short backoff.
+                    self._watch_fail_backoff()
+                    return
+                verdict = self._watch_apply(msg)
+                if verdict == "redirect":
+                    await self._watch_redirect(msg)
+                    return
+                if verdict == "applied":
+                    self._stream_retry_n = 0
+        except grpc.aio.AioRpcError as e:
+            self._watch_error(e)
+        except Exception:
+            log.exception("%s: capacity stream failed", self.id)
+            self._watch_fail_backoff()
+        finally:
+            if pending is not None:
+                pending.cancel()
+            if wake_task is not None:
+                wake_task.cancel()
+            try:
+                call.cancel()
+            except Exception:
+                pass
+
+    async def stream_step(self, drain_timeout: float = 0.2) -> dict:
+        """One deterministic streaming step for stepped harnesses (the
+        chaos runner; the background task must NOT be running):
+        establish the stream if due, drain the pushes already in
+        flight, chase a terminal redirect, and fall back to ONE poll
+        whenever the stream is down or has been silent past the
+        refresh interval. Returns {"pushes": n, "events": [...]} with
+        deterministic event tags (establish/shed/unimplemented/eof/
+        redirect/error/poll)."""
+        out = {"pushes": 0, "events": []}
+        now = self._clock()
+        if (
+            self._watch_call is None
+            and self._stream
+            and self.resources
+            and now >= self._stream_retry_at
+        ):
+            if self._watch_pending is not None:
+                self._watch_pending.cancel()
+                self._watch_pending = None
+            try:
+                await self.conn.ensure()
+                self._watch_call = self.conn.stub.WatchCapacity(
+                    self._watch_request(),
+                    metadata=trace_mod.grpc_metadata(),
+                )
+                self._watch_last = now
+                out["events"].append("establish")
+            except Exception:
+                self._watch_call = None
+                self._watch_fail_backoff()
+        if self._watch_call is not None:
+            while True:
+                if self._watch_pending is None:
+                    self._watch_pending = asyncio.ensure_future(
+                        self._watch_call.read()
+                    )
+                done, _ = await asyncio.wait(
+                    {self._watch_pending}, timeout=drain_timeout
+                )
+                if not done:
+                    break  # nothing in flight; the read stays pending
+                task, self._watch_pending = self._watch_pending, None
+                try:
+                    msg = task.result()
+                except grpc.aio.AioRpcError as e:
+                    self._watch_call = None
+                    out["events"].append(self._watch_error(e))
+                    break
+                except Exception:
+                    self._watch_call = None
+                    self._watch_fail_backoff()
+                    out["events"].append("error")
+                    break
+                if msg is grpc.aio.EOF:
+                    self._watch_call = None
+                    self._stream_retry_at = now
+                    out["events"].append("eof")
+                    break
+                verdict = self._watch_apply(msg)
+                if verdict == "redirect":
+                    self._watch_call = None
+                    out["events"].append("redirect")
+                    await self._watch_redirect(msg)
+                    break
+                if verdict == "applied":
+                    out["pushes"] += 1
+        if self._watch_call is None or now >= self._watch_poll_deadline():
+            # Down, or quiet into the lease-expiry margin: one poll
+            # (lease-expiry safety; also how a stepped run ships wants
+            # changes and chases redirects).
+            await self.refresh_once()
+            self._watch_last = now
+            out["events"].append("poll")
+        return out

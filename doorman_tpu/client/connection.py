@@ -98,6 +98,19 @@ class Connection:
             self.stub = None
             self.current_master = ""
 
+    async def ensure(self) -> None:
+        """Dial if no channel is open (stream mode establishes its
+        WatchCapacity call directly on the stub instead of through
+        execute(), which is shaped around unary request/response)."""
+        if self._channel is None:
+            await self._connect(self.addr)
+
+    async def redirect(self, addr: str) -> None:
+        """Reconnect to an indicated master — the stream-mode analog of
+        execute()'s mastership chase (a terminal WatchCapacityResponse
+        carries the address instead of a unary mastership field)."""
+        await self._connect(addr)
+
     async def execute(
         self, call: Callable[[CapacityStub], Awaitable[T]]
     ) -> T:

@@ -143,6 +143,19 @@ def make_parser() -> argparse.ArgumentParser:
                         "within the window; 0 leaves overload "
                         "detection to the latency/queue/tick-lag "
                         "signals alone")
+    p.add_argument("--stream-push", action="store_true",
+                   help="serve WatchCapacity: clients hold one stream "
+                        "and lease deltas are pushed at tick edges "
+                        "instead of answering per-interval polls; off "
+                        "leaves WatchCapacity UNIMPLEMENTED and "
+                        "stream-mode clients fall back to polling "
+                        "(doc/streaming.md)")
+    p.add_argument("--max-streams-per-band", type=int, default=0,
+                   help="stream push: cap on open WatchCapacity "
+                        "streams PER priority band — establishment "
+                        "past it sheds with RESOURCE_EXHAUSTED + "
+                        "retry-after so fanout cannot starve the "
+                        "tick; 0 = unlimited")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -254,6 +267,8 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         flightrec_dir=args.flightrec_dir or None,
         fuse_admission=args.fuse_admission,
         tick_pipeline_depth=args.tick_pipeline_depth,
+        stream_push=args.stream_push,
+        max_streams_per_band=args.max_streams_per_band,
     )
 
     port = await server.start(

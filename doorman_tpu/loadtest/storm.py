@@ -16,6 +16,11 @@ and standalone against a real deployment:
 
     python -m doorman_tpu.loadtest.storm --server localhost:15000 \
         --resource storm --workers 64 --duration 10 --bands 0,1,2
+
+``--stream`` swaps the closed-loop polls for held WatchCapacity
+streams (doc/streaming.md): workers subscribe, count pushed deltas,
+and re-establish after sheds/resets/redirects with the same
+retry-after pacing — the storm shape for the per-band stream caps.
 """
 
 from __future__ import annotations
@@ -119,6 +124,93 @@ async def _worker(
                 stats["errors"] += 1
 
 
+async def _stream_worker(
+    index: int,
+    addr: str,
+    resource: str,
+    band: int,
+    wants: float,
+    deadline: float,
+    stats: Dict,
+    rng: random.Random,
+    honor_retry_after: bool,
+) -> None:
+    """One WatchCapacity subscriber: hold a stream, count pushes, and
+    re-establish — honoring the shed retry-after hint with the real
+    client's half jitter — whenever the stream is shed, reset, or
+    redirected. In stream mode ``ok``/``latencies`` count successful
+    establishments (the admitted RPCs), ``pushes`` the lease deltas."""
+    from doorman_tpu.proto import doorman_stream_pb2 as spb
+
+    async with grpc.aio.insecure_channel(addr) as channel:
+        stub = CapacityStub(channel)
+        request = spb.WatchCapacityRequest(client_id=f"storm-{index}")
+        rr = request.resource.add()
+        rr.resource_id = resource
+        rr.wants = wants
+        rr.priority = band
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            call = stub.WatchCapacity(request)
+            try:
+                established = False
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    msg = await asyncio.wait_for(
+                        call.read(), timeout=remaining
+                    )
+                    if msg is grpc.aio.EOF:
+                        stats["resets"] += 1
+                        break
+                    if msg.HasField("mastership"):
+                        stats["redirects"] += 1
+                        break
+                    if not established:
+                        established = True
+                        stats["ok"] += 1
+                        stats["ok_by_band"][band] = (
+                            stats["ok_by_band"].get(band, 0) + 1
+                        )
+                        latency = time.monotonic() - t0
+                        stats["latencies"].append(latency)
+                        stats["latencies_by_band"].setdefault(
+                            band, []
+                        ).append(latency)
+                    stats["pushes"] += 1
+                    # Carry the resume contract like the real client:
+                    # seq token + has baseline ride re-establishment.
+                    request.resume_seq = max(
+                        request.resume_seq, int(msg.seq)
+                    )
+                    for row in msg.response:
+                        if row.resource_id == resource:
+                            rr.has.CopyFrom(row.gets)
+            except asyncio.TimeoutError:
+                return  # duration over; cancelling the read ends the RPC
+            except grpc.aio.AioRpcError as e:
+                if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    stats["shed"] += 1
+                    stats["shed_by_band"][band] = (
+                        stats["shed_by_band"].get(band, 0) + 1
+                    )
+                    if honor_retry_after:
+                        hint = _retry_after(e) or 1.0
+                        await asyncio.sleep(
+                            min(
+                                0.5 * hint + rng.uniform(0, 0.5 * hint),
+                                max(deadline - time.monotonic(), 0.0),
+                            )
+                        )
+                else:
+                    stats["errors"] += 1
+            except Exception:
+                stats["errors"] += 1
+            finally:
+                call.cancel()
+
+
 async def run_storm(
     addr: str,
     resource: str = "storm",
@@ -130,26 +222,44 @@ async def run_storm(
     honor_retry_after: bool = True,
     rpc_timeout: Optional[float] = None,
     seed: int = 0,
+    stream: bool = False,
 ) -> Dict:
     """Drive `workers` closed-loop GetCapacity clients (round-robin
     over `bands`) for `duration` seconds; returns aggregate stats with
-    per-band goodput and latency percentiles (seconds)."""
+    per-band goodput and latency percentiles (seconds). With
+    ``stream=True`` the workers hold WatchCapacity streams instead:
+    ``ok``/``latencies`` become establishment counts/latencies,
+    ``pushes`` counts received deltas, and shed establishments honor
+    the retry-after hint before reconnecting."""
     stats: Dict = {
         "ok": 0, "shed": 0, "errors": 0, "redirects": 0,
         "ok_by_band": {}, "shed_by_band": {}, "latencies": [],
         "latencies_by_band": {},
     }
+    if stream:
+        stats["pushes"] = 0
+        stats["resets"] = 0
     rng = random.Random(seed)
     deadline = time.monotonic() + duration
     start = time.monotonic()
-    await asyncio.gather(*(
-        _worker(
-            i, addr, resource, bands[i % len(bands)], wants, deadline,
-            stats, random.Random(rng.random()), honor_retry_after,
-            rpc_timeout,
-        )
-        for i in range(workers)
-    ))
+    if stream:
+        await asyncio.gather(*(
+            _stream_worker(
+                i, addr, resource, bands[i % len(bands)], wants,
+                deadline, stats, random.Random(rng.random()),
+                honor_retry_after,
+            )
+            for i in range(workers)
+        ))
+    else:
+        await asyncio.gather(*(
+            _worker(
+                i, addr, resource, bands[i % len(bands)], wants,
+                deadline, stats, random.Random(rng.random()),
+                honor_retry_after, rpc_timeout,
+            )
+            for i in range(workers)
+        ))
     elapsed = max(time.monotonic() - start, 1e-9)
     lat = sorted(stats.pop("latencies"))
     lat_by_band = {
@@ -198,6 +308,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="per-RPC gRPC deadline in seconds (0: none); "
                         "short deadlines exercise the admission "
                         "fast-fail path")
+    p.add_argument("--stream", action="store_true",
+                   help="hold WatchCapacity streams instead of "
+                        "closed-loop polls; shed establishments honor "
+                        "retry-after before reconnecting "
+                        "(doc/streaming.md)")
     return p
 
 
@@ -214,6 +329,7 @@ def main(argv=None) -> None:
         wants=args.wants,
         honor_retry_after=not args.ignore_retry_after,
         rpc_timeout=args.rpc_timeout or None,
+        stream=args.stream,
     ))
     import json
 

@@ -81,9 +81,11 @@ KNOWN_SPAN_NAMES = frozenset({
     "server.*",  # per-RPC handler spans: server.GetCapacity, ...
     "client.refresh",
     "client.GetCapacity",
+    "client.WatchCapacity",  # stream establishment + read loop
     "admission.window",
     "persist.snapshot",
     "persist.restore",
+    "stream.fanout",  # tick-edge lease push (server/streams.py)
 })
 KNOWN_INSTANT_NAMES = frozenset({
     "election.transition",

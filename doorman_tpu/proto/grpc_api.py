@@ -16,6 +16,7 @@ from __future__ import annotations
 import grpc
 
 from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto import doorman_stream_pb2 as spb
 
 SERVICE_NAME = "doorman_tpu.Capacity"
 
@@ -25,6 +26,11 @@ _METHODS = {
     "GetCapacity": (pb.GetCapacityRequest, pb.GetCapacityResponse),
     "GetServerCapacity": (pb.GetServerCapacityRequest, pb.GetServerCapacityResponse),
     "ReleaseCapacity": (pb.ReleaseCapacityRequest, pb.ReleaseCapacityResponse),
+}
+
+# Server-streaming methods (unary request, response stream).
+_STREAM_METHODS = {
+    "WatchCapacity": (spb.WatchCapacityRequest, spb.WatchCapacityResponse),
 }
 
 
@@ -42,12 +48,24 @@ class CapacityStub:
                     response_deserializer=resp_cls.FromString,
                 ),
             )
+        for name, (req_cls, resp_cls) in _STREAM_METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_stream(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
 
 
 class CapacityServicer:
-    """Base servicer; subclass and override the four methods.
+    """Base servicer; subclass and override the methods.
 
-    Methods may be plain functions (sync server) or coroutines (aio server).
+    Methods may be plain functions (sync server) or coroutines (aio
+    server); WatchCapacity is server-streaming — an (async) generator
+    yielding WatchCapacityResponse messages.
     """
 
     def Discovery(self, request, context):
@@ -62,6 +80,9 @@ class CapacityServicer:
     def ReleaseCapacity(self, request, context):
         raise NotImplementedError
 
+    def WatchCapacity(self, request, context):
+        raise NotImplementedError
+
 
 def add_capacity_servicer(server, servicer: CapacityServicer) -> None:
     """Register `servicer` on a grpc or grpc.aio server."""
@@ -73,6 +94,12 @@ def add_capacity_servicer(server, servicer: CapacityServicer) -> None:
         )
         for name, (req_cls, resp_cls) in _METHODS.items()
     }
+    for name, (req_cls, resp_cls) in _STREAM_METHODS.items():
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
     )

@@ -34,6 +34,7 @@ from doorman_tpu.core.resource import Resource, algo_kind_for
 from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto import doorman_stream_pb2 as spb
 from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
 from doorman_tpu.server import config as config_mod
 from doorman_tpu.server.election import Election
@@ -108,6 +109,8 @@ class CapacityServer(CapacityServicer):
         flightrec_dir: Optional[str] = None,
         fuse_admission: bool = False,
         tick_pipeline_depth: int = 1,
+        stream_push: bool = False,
+        max_streams_per_band: int = 0,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -220,6 +223,28 @@ class CapacityServer(CapacityServicer):
             admission.bind(self) if admission is not None else None
         )
 
+        # Streaming lease push (doorman_tpu.server.streams): clients
+        # hold one WatchCapacity stream instead of polling, and the
+        # tick-edge fanout pushes only the rows whose lease moved. Off
+        # by default (WatchCapacity answers UNIMPLEMENTED and clients
+        # fall back to polling); see doc/streaming.md.
+        self._streams = None
+        if stream_push:
+            from doorman_tpu.server.streams import StreamRegistry
+
+            self._streams = StreamRegistry(
+                self, max_streams_per_band=max_streams_per_band
+            )
+        # Delta bookkeeping for the fanout: ticks whose changes have no
+        # tracked source (python store, overflow fallback, wide/priority
+        # solver parts, config epoch moves) force a full subscription
+        # check instead of the engine's changed-rid filter.
+        self._stream_check_all = True
+        self._stream_force_ids: set = set()
+        self._stream_epoch_seen = -1
+        self._rid_map_key = None
+        self._rid_map: Dict[int, str] = {}
+
         # Per-tick flight recorder (doorman_tpu.obs.flightrec): one
         # structured record per tick_once, auto-dumped on an unhandled
         # tick exception; /debug/flightrec serves the ring on demand.
@@ -304,6 +329,11 @@ class CapacityServer(CapacityServicer):
             # Batch servers flush/snapshot from the tick pipeline; an
             # immediate-mode server needs its own durability beat.
             self._tasks.append(asyncio.create_task(self._persist_loop()))
+        if self._streams is not None and self.mode != "batch":
+            # Batch servers push at tick edges (tick_once); an
+            # immediate-mode server has no tick, so the fanout gets its
+            # own beat at the same cadence.
+            self._tasks.append(asyncio.create_task(self._stream_loop()))
         return self.port
 
     async def stop(self) -> None:
@@ -403,6 +433,13 @@ class CapacityServer(CapacityServicer):
         else:
             log.warning("%s: this server lost mastership", self.id)
             self.became_master_at = 0.0
+            if self._streams is not None:
+                # Every open capacity stream ends with a terminal
+                # mastership redirect — the streaming analog of the
+                # unary mastership response. Clients fall back to
+                # polling and re-establish against the new master
+                # (resuming from their has-baseline).
+                self._streams.terminate_all(self._mastership())
             if was_master and self._persist is not None:
                 # Flush the terminal step-down marker BEFORE the state
                 # wipe: it certifies the journal as complete, which is
@@ -419,6 +456,8 @@ class CapacityServer(CapacityServicer):
         self._resident_wide = None
         self._resident_wide_pipe.drop()
         self._resident_ok_key = None
+        self._stream_check_all = True
+        self._rid_map_key = None
         self.last_restore = None
         if is_master and self._persist is not None and self.config is not None:
             # Warm takeover: rebuild the just-wiped state from the
@@ -518,6 +557,12 @@ class CapacityServer(CapacityServicer):
                 # write path — without admission every write is
                 # untracked and the cache would just be invalidated.
                 self._resident.attach_staging()
+            if self._streams is not None:
+                # Streaming lease push: the tick executable compares
+                # delivered rows against a resident previous-grants
+                # table so the fanout only re-decides subscribers of
+                # rows that actually moved (engine delta tracking).
+                self._resident.enable_delta_tracking()
             if self.flightrec is not None:
                 self._resident.on_anomaly = self._solver_anomaly
         return self._resident
@@ -714,6 +759,11 @@ class CapacityServer(CapacityServicer):
                     # beat: flush this tick's journal deltas and take the
                     # cadenced snapshot inside the tick span.
                     self.persist_step()
+                    # Tick-edge stream fanout: push lease deltas to
+                    # WatchCapacity subscribers of the rows this tick
+                    # moved (the fanout's decides are journal deltas of
+                    # the NEXT flush beat).
+                    self.push_streams()
             except Exception as exc:
                 # The black box's trigger: an unhandled tick exception
                 # dumps the last N ticks before the error propagates
@@ -769,6 +819,13 @@ class CapacityServer(CapacityServicer):
                 r for r in resources
                 if algo_kind_for(r.template) == AlgoKind.PRIORITY_BANDS
             ]
+            if self._streams is not None:
+                # Wide and priority rows solve outside the delta-tracked
+                # narrow path: their subscribers are checked every tick
+                # (the narrow rows keep the changed-rid filter).
+                self._stream_force_ids = (
+                    {r.id for r in wide_res} | {r.id for r in prio_res}
+                )
             # Resolved HERE, on the event loop, so solver/resources/
             # epoch stay mutually consistent under a concurrent
             # mastership flip (see _resident_step).
@@ -823,6 +880,9 @@ class CapacityServer(CapacityServicer):
                     self._resident_ok_key = None  # doorman: allow[lock-discipline]
                     self._resident_pipe.drop()
                     self._resident_wide_pipe.drop()
+                    # The fallback tick applied grants with no delta
+                    # tracking (and dropped handles lost theirs).
+                    self._stream_check_all = True  # doorman: allow[lock-discipline] same serialization as _resident_ok_key
                     run_tick()
 
             # copy_context: executor threads don't inherit contextvars,
@@ -830,9 +890,11 @@ class CapacityServer(CapacityServicer):
             ctx = contextvars.copy_context()
             await loop.run_in_executor(None, ctx.run, resident_or_fallback)
         elif self._native_store:
+            self._stream_check_all = True
             ctx = contextvars.copy_context()
             await loop.run_in_executor(None, ctx.run, run_tick)
         else:
+            self._stream_check_all = True
             snap = solver.prepare(resources)
             ctx = contextvars.copy_context()
             gets = await loop.run_in_executor(None, ctx.run, solver.solve, snap)
@@ -868,6 +930,84 @@ class CapacityServer(CapacityServicer):
             self._persist.step(self)
         except Exception:
             log.exception("%s: persistence step failed", self.id)
+
+    # ------------------------------------------------------------------
+    # Streaming lease push (doorman_tpu.server.streams)
+    # ------------------------------------------------------------------
+
+    async def _stream_loop(self) -> None:
+        """The immediate-mode fanout beat (batch servers push from
+        tick_once instead)."""
+        while True:
+            await asyncio.sleep(self.tick_interval)
+            if self.is_master:
+                self.push_streams()
+
+    def push_streams(self) -> None:
+        """One tick-edge stream fanout: hand the registry the resource
+        ids whose grants moved (or check_all when no tracked delta
+        source covered this tick) and let it push deltas. Driven by
+        tick_once (batch mode), the _stream_loop beat (immediate mode),
+        or a stepped harness (the chaos runner). Runs on the event
+        loop; must never take down the tick — fanout trouble logs."""
+        if self._streams is None or not self.is_master:
+            return
+        if not len(self._streams):
+            # Still drain the delta set so stale rids cannot flood the
+            # first subscriber's tick.
+            self._stream_changed()
+            return
+        tracer = trace_mod.default_tracer()
+        try:
+            with tracer.span(
+                "stream.fanout", cat="server",
+                args={"server": self.id,
+                      "subscribers": len(self._streams)},
+            ):
+                changed, check_all = self._stream_changed()
+                self._streams.on_tick(changed, check_all)
+        except Exception:
+            log.exception("%s: stream fanout failed", self.id)
+
+    def _stream_changed(self):
+        """(changed_ids, check_all) for this fanout: the delta-tracked
+        engine's changed rids mapped to resource ids, plus the rows
+        forced by untracked solver parts; check_all when anything made
+        the filter unsound (config epoch move, fallback tick, python
+        store, restore)."""
+        check_all = self._stream_check_all or self.mode != "batch"
+        self._stream_check_all = False
+        if self._config_epoch != self._stream_epoch_seen:
+            # Config moves change safe_capacity / algorithms without
+            # any store delivery; recheck everything once.
+            self._stream_epoch_seen = self._config_epoch
+            check_all = True
+        solver = self._resident
+        if solver is None or not solver.delta_tracking:
+            return None, True
+        changed: set = set()
+        rid_map = self._rid_resource_map()
+        for rid in solver.take_changed_rids():
+            resource_id = rid_map.get(rid)
+            if resource_id is not None:
+                changed.add(resource_id)
+        if check_all:
+            return None, True
+        changed |= self._stream_force_ids
+        return changed, False
+
+    def _rid_resource_map(self) -> Dict[int, str]:
+        """Engine rid -> resource id (native stores only), cached like
+        _resident_eligible against the config epoch and resource count."""
+        key = (self._config_epoch, len(self.resources))
+        if key != self._rid_map_key:
+            self._rid_map_key = key
+            self._rid_map = {
+                res.store._rid: rid
+                for rid, res in self.resources.items()
+                if hasattr(res.store, "_rid")
+            }
+        return self._rid_map
 
     # ------------------------------------------------------------------
     # Flight recorder + SLO evaluation
@@ -917,6 +1057,14 @@ class CapacityServer(CapacityServicer):
         )
         if depth_used > 1:
             rec["pipeline_in_flight"] = depth_used
+        if self._streams is not None:
+            # Stream-push load of this tick: who is subscribed, how many
+            # delta rows went out, and the bytes they cost — the triage
+            # counters for "the fanout is eating the tick".
+            st = self._streams.take_tick_stats()
+            rec["subscribers"] = st["subscribers"]
+            rec["deltas_pushed"] = st["deltas_pushed"]
+            rec["push_bytes"] = st["push_bytes"]
         if self._admission is not None:
             admitted = 0
             shed_by_band: Dict[str, int] = {}
@@ -1124,6 +1272,71 @@ class CapacityServer(CapacityServicer):
                     sum(r.wants for r in request.resource),
                     dur, err,
                 )
+
+    async def WatchCapacity(self, request, context):
+        """Streaming lease push: one subscription request, a stream of
+        tick-edge deltas (doc/streaming.md). Establishment walks the
+        same gate as a poll — mastership, validation, admission (AIMD
+        band shed + per-band stream cap) — then the registry owns the
+        stream until it ends with a terminal mastership redirect."""
+        start = self._clock()
+        err = True
+        try:
+            with self._rpc_span("WatchCapacity", context,
+                                request.client_id):
+                if self._streams is None:
+                    await context.abort(
+                        grpc.StatusCode.UNIMPLEMENTED,
+                        "stream push is disabled on this server "
+                        "(--stream-push)",
+                    )
+                if not self.is_master:
+                    out = spb.WatchCapacityResponse()
+                    out.mastership.CopyFrom(self._mastership())
+                    err = False
+                    yield out
+                    return
+                msg = config_mod.validate_get_capacity_request(request)
+                if msg is not None:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT, msg
+                    )
+                band = max(
+                    (rr.priority for rr in request.resource), default=0
+                )
+                shed = None
+                if self._admission is not None:
+                    shed = self._admission.check_watch(request)
+                if shed is None:
+                    shed = self._streams.check_cap(band)
+                if shed is not None:
+                    # Same wire contract as a shed poll: the pacing
+                    # hint rides trailing metadata (doc/admission.md).
+                    context.set_trailing_metadata((
+                        (RETRY_AFTER_KEY, f"{shed.retry_after:.3f}"),
+                    ))
+                    await context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, shed.reason
+                    )
+                sub = self._streams.subscribe(request)
+                err = False
+        finally:
+            dur = self._clock() - start
+            self.on_request("WatchCapacity", dur, err)
+            self.request_log.record(
+                "WatchCapacity", request.client_id,
+                [r.resource_id for r in request.resource],
+                sum(r.wants for r in request.resource),
+                dur, err,
+            )
+        try:
+            while True:
+                out = await sub.queue.get()
+                yield out
+                if out.HasField("mastership"):
+                    return
+        finally:
+            self._streams.unsubscribe(sub)
 
     async def GetServerCapacity(self, request, context):
         start = self._clock()
@@ -1533,6 +1746,12 @@ class CapacityServer(CapacityServicer):
                 if self._admission is not None
                 else None
             ),
+            # Streaming lease push (None: --stream-push off).
+            "streams": (
+                self._streams.status()
+                if self._streams is not None
+                else None
+            ),
             "last_restore": self.last_restore,
             "flightrec": (
                 self.flightrec.status()
@@ -1611,10 +1830,14 @@ FUSED_TRACKED_WRITERS = frozenset({
     # whole cache on a partially-applied window. (It calls both hooks
     # inline, so it self-certifies; listed for documentation.)
     "Coalescer._decide_batch",
-    # _decide writes one row per call; its three call sites own the
+    # _decide writes one row per call; its four call sites own the
     # contract: Coalescer._decide_batch re-stages after the window's
-    # writes, _get_server_capacity invalidates after the band loop, and
-    # GetCapacity's direct loop only runs with admission off (below).
+    # writes, _get_server_capacity invalidates after the band loop,
+    # GetCapacity's direct loop only runs with admission off (below),
+    # and the stream registry (server/streams.py) invalidates on its
+    # establishment decide — the only one of its decides that changes
+    # packed bytes (steady refreshes rewrite identical wants; see
+    # StreamRegistry._decide).
     "CapacityServer._decide",
     # The direct per-request loop runs only when admission is None
     # (coalescing otherwise owns every GetCapacity decide), and fused

@@ -1,0 +1,379 @@
+"""Tick-edge lease push: the WatchCapacity subscription registry.
+
+One `StreamRegistry` per server owns every open WatchCapacity stream:
+which client subscribed to which resources (and at what wants/band),
+what lease each subscription last observed, and the per-stream outbound
+queue the gRPC handler drains. At every tick edge the server hands the
+registry the set of resources whose delivered grants moved (the tick
+engine's device-extracted delta set — solver/engine.py delta tracking)
+and the registry runs the SAME decide path a GetCapacity poll would run
+— but only for subscribers of rows that actually changed, plus the
+subscriptions due for their silent refresh beat. A push therefore
+carries exactly the bytes a poll at the same instant would have
+carried; change detection compares the decide RESULT against the last
+pushed lease, so parity with poll-every-tick holds even when the delta
+filter over-approximates (it may only ever over-approximate — a missed
+resource is caught at the subscription's next refresh beat, the same
+staleness bound a polling client lives with).
+
+Ordering / exactly-once: every pushed message carries a seq — the
+persist journal's sequence number when persistence is configured (the
+decides that built the push are themselves journal deltas), else a
+registry counter. A stream is a single writer, so seqs are strictly
+increasing per stream; clients drop seq <= the last applied and offer
+the last seen seq back as `resume_seq` on reconnect. Resume does not
+REPLAY history (none is retained): the reconnect request's `has` fields
+are the client's baseline, and the first message carries only the rows
+whose current lease differs from it — byte-identical to what the
+missed pushes would have converged to.
+
+Concurrency: every registry method runs on the server's event loop
+(RPC handlers and the post-tick fanout both live there); no locks. The
+only cross-thread input is the tick engine's changed-rid set, drained
+by the server before it calls on_tick.
+
+Silent refresh: each subscription is refreshed (decide, no push unless
+the lease moved) on its resources' refresh-interval cadence, exactly
+like a polling client — so server-side lease expiry keeps being pushed
+out while the stream is quiet, and learning-mode scalar decisions keep
+being re-evaluated.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Set, Tuple
+
+from doorman_tpu.admission.policy import Shed
+from doorman_tpu.algorithms import Request
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto import doorman_stream_pb2 as spb
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StreamRegistry", "Subscription"]
+
+# Outbound queue depth per stream. A consumer this far behind (the
+# fanout produces at tick cadence; a healthy stream drains in
+# microseconds) is reset with a redirect-to-self terminal message — the
+# client reconnects and resumes from its has-baseline, which is both
+# cheaper and more correct than dropping arbitrary deltas.
+QUEUE_SIZE = 256
+
+
+class Subscription:
+    """One open WatchCapacity stream."""
+
+    def __init__(self, client_id: str, band: int,
+                 lines: Dict[str, Tuple[float, int]]):
+        self.client_id = client_id
+        self.band = band
+        # resource_id -> (wants, priority), fixed at establishment
+        # (clients change wants by re-establishing the stream).
+        self.lines = lines
+        # resource_id -> (capacity, safe_capacity, refresh_interval):
+        # the change-detection key of the last served lease.
+        self.last: Dict[str, tuple] = {}
+        self.queue: "asyncio.Queue" = asyncio.Queue(maxsize=QUEUE_SIZE)
+        self.next_refresh = 0.0
+        self.terminated = False
+
+
+class StreamRegistry:
+    """All open streams of one CapacityServer (see module docstring)."""
+
+    def __init__(self, server, *, max_streams_per_band: int = 0):
+        self._server = server
+        # 0 = unlimited. The cap is per wire-priority band so a flood of
+        # low-band stream establishment can never crowd the fanout (and
+        # the tick it rides) out from under high-band subscribers.
+        self.max_streams_per_band = int(max_streams_per_band)
+        self._subs: Set[Subscription] = set()
+        self._band_counts: Dict[int, int] = {}
+        self._seq = 0
+        # Lifetime counters (status pages) and per-tick counters
+        # (the flight recorder's subscriber/deltas/bytes fields).
+        self.total_messages = 0
+        self.total_deltas = 0
+        self.total_bytes = 0
+        self.total_resets = 0
+        self._tick_deltas = 0
+        self._tick_bytes = 0
+        self._tick_messages = 0
+
+    # -- establishment -------------------------------------------------
+
+    def check_cap(self, band: int) -> Optional[Shed]:
+        """Per-band stream cap (enforced with or without the admission
+        front-end; the AIMD gate is admission.check_watch)."""
+        cap = self.max_streams_per_band
+        if cap and self._band_counts.get(band, 0) >= cap:
+            s = self._server
+            return Shed(
+                reason=(
+                    f"stream cap: band {band} already holds {cap} "
+                    "streams on this server"
+                ),
+                retry_after=max(
+                    s.tick_interval, s.minimum_refresh_interval, 1.0
+                ),
+                band=band,
+                kind="stream_cap",
+            )
+        return None
+
+    def subscribe(self, request) -> Subscription:
+        """Register one stream and enqueue its first message: a
+        seq-stamped snapshot of every subscribed resource — or, on a
+        resume (resume_seq > 0 with `has` baselines), only the rows
+        whose current lease differs from what the client already holds."""
+        now = self._server._clock()
+        band = max((rr.priority for rr in request.resource), default=0)
+        lines = {
+            rr.resource_id: (rr.wants, rr.priority)
+            for rr in request.resource
+        }
+        sub = Subscription(request.client_id, band, lines)
+        resume = request.resume_seq > 0
+        baseline: Dict[str, float] = {
+            rr.resource_id: rr.has.capacity
+            for rr in request.resource
+            if rr.HasField("has")
+        }
+        self._subs.add(sub)
+        self._band_counts[band] = self._band_counts.get(band, 0) + 1
+        rows = []
+        for rid in lines:
+            # The establishment decide carries the client-reported
+            # lease as `has` — byte-for-byte what this client's next
+            # poll would have carried (scalar algorithms read it).
+            lease, res = self._decide(
+                sub, rid, first=True, has=baseline.get(rid)
+            )
+            sub.last[rid] = self._key(lease, res)
+            prev = baseline.get(rid) if resume else None
+            if prev is None or lease.has != prev:
+                rows.append(self._row(rid, lease, res))
+        sub.next_refresh = now + self._refresh_interval(sub)
+        # The first message is pushed even when a resume found nothing
+        # moved: it carries the current seq and proves liveness.
+        self._enqueue(sub, self._message(rows, snapshot=True))
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        """Drop one stream (the handler's finally; idempotent)."""
+        if sub in self._subs:
+            self._subs.discard(sub)
+            n = self._band_counts.get(sub.band, 0) - 1
+            if n > 0:
+                self._band_counts[sub.band] = n
+            else:
+                self._band_counts.pop(sub.band, None)
+
+    # -- the tick-edge fanout ------------------------------------------
+
+    def on_tick(self, changed_ids: "Optional[Set[str]]",
+                check_all: bool) -> None:
+        """Push deltas for one tick edge. `changed_ids` is the resource
+        ids whose grants the tick moved (the engine's delta set plus any
+        resources solved outside the delta-tracked path); check_all=True
+        means no tracked source of deltas existed this tick (python
+        store, config epoch move, restore) — every subscription line is
+        re-decided. Resources in learning mode are always checked: their
+        scalar decisions move without store deliveries."""
+        if not self._subs:
+            return
+        server = self._server
+        now = server._clock()
+        tick = server._ticks_done
+        for sub in list(self._subs):
+            if sub.terminated:
+                continue
+            due = now >= sub.next_refresh
+            rows = []
+            for rid in sub.lines:
+                if (
+                    not (check_all or due)
+                    and (changed_ids is None or rid not in changed_ids)
+                ):
+                    res = server.resources.get(rid)
+                    if res is None or not res.in_learning_mode:
+                        continue
+                lease, res = self._decide(sub, rid, first=False)
+                key = self._key(lease, res)
+                if key != sub.last.get(rid):
+                    sub.last[rid] = key
+                    rows.append(self._row(rid, lease, res))
+            if due:
+                sub.next_refresh = now + self._refresh_interval(sub)
+            if rows:
+                self._enqueue(sub, self._message(rows, tick=tick))
+
+    # -- termination ---------------------------------------------------
+
+    def terminate(self, sub: Subscription, mastership) -> None:
+        """End one stream with a terminal redirect message. A full
+        queue is drained first — the terminal supersedes any deltas the
+        consumer never read (it will resume from its has-baseline)."""
+        if sub.terminated:
+            return
+        sub.terminated = True
+        msg = spb.WatchCapacityResponse(seq=self._next_seq())
+        msg.mastership.CopyFrom(mastership)
+        while True:
+            try:
+                sub.queue.put_nowait(msg)
+                return
+            except asyncio.QueueFull:
+                try:
+                    sub.queue.get_nowait()
+                except asyncio.QueueEmpty:  # pragma: no cover - racy only
+                    pass
+
+    def terminate_all(self, mastership) -> int:
+        """Mastership lost (or shutting down): every stream ends with a
+        redirect so clients chase the new master — the streaming analog
+        of the unary mastership response. Returns streams terminated."""
+        n = 0
+        for sub in list(self._subs):
+            if not sub.terminated:
+                self.terminate(sub, mastership)
+                n += 1
+        if n:
+            log.info(
+                "%s: terminated %d capacity stream(s) with a mastership "
+                "redirect", self._server.id, n,
+            )
+        return n
+
+    def reset(self, sub: Subscription) -> None:
+        """Slow-consumer reset: terminal redirect pointing at the
+        CURRENT master (normally this server) — reconnect and resume."""
+        self.total_resets += 1
+        self.terminate(sub, self._server._mastership())
+
+    # -- the decide path (byte-identical to a poll) --------------------
+
+    def _decide(self, sub: Subscription, rid: str, *, first: bool,
+                has: "Optional[float]" = None):
+        wants, priority = sub.lines[rid]
+        if has is None:
+            last = sub.last.get(rid)
+            has = last[0] if last else 0.0
+        lease, res = self._server._decide(
+            rid, Request(sub.client_id, has, wants, 1, priority=priority)
+        )
+        if first:
+            # The establishment decide registers a new client in the
+            # row (wants write + membership bump) outside the admission
+            # coalescer's tracked pass: a staged pack of this row
+            # predates it (engine.FusedStaging's freshness contract).
+            # Steady-state refreshes rewrite the same wants — the
+            # packed fields are byte-unchanged, so they need no
+            # invalidation (the same argument FusedStaging makes for
+            # its one-tick drain window).
+            self._server._fused_invalidate(rid)
+        return lease, res
+
+    @staticmethod
+    def _key(lease, res) -> tuple:
+        """Change-detection key: what a client OBSERVES of a lease.
+        Expiry is deliberately excluded — it advances on every silent
+        refresh, and pushing it would reduce the stream to a poll."""
+        return (lease.has, res.safe_capacity(), int(lease.refresh_interval))
+
+    @staticmethod
+    def _row(rid: str, lease, res) -> pb.ResourceResponse:
+        """One pushed row, field-for-field what GetCapacity builds."""
+        row = pb.ResourceResponse()
+        row.resource_id = rid
+        row.gets.expiry_time = int(lease.expiry)
+        row.gets.refresh_interval = int(lease.refresh_interval)
+        row.gets.capacity = lease.has
+        row.safe_capacity = res.safe_capacity()
+        return row
+
+    def _refresh_interval(self, sub: Subscription) -> float:
+        """The silent-refresh cadence: the shortest served refresh
+        interval, floored like a polling client's loop."""
+        interval = min(
+            (key[2] for key in sub.last.values()), default=None
+        )
+        if interval is None:
+            interval = self._server.tick_interval
+        return max(
+            float(interval), self._server.minimum_refresh_interval,
+            self._server.tick_interval,
+        )
+
+    # -- plumbing ------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        persist = self._server._persist
+        if persist is not None:
+            # The decides that built this push are journal deltas; the
+            # journal seq therefore stamps the push with a durable,
+            # replayable position (doc/streaming.md). max() keeps seqs
+            # strictly increasing even when a message carried no
+            # journaled decide (terminal redirects).
+            self._seq = max(self._seq + 1, persist.journal.seq)
+        else:
+            self._seq += 1
+        return self._seq
+
+    def _message(self, rows, *, snapshot: bool = False,
+                 tick: int = 0) -> spb.WatchCapacityResponse:
+        msg = spb.WatchCapacityResponse(
+            seq=self._next_seq(), tick=tick, snapshot=snapshot
+        )
+        for row in rows:
+            msg.response.append(row)
+        return msg
+
+    def _enqueue(self, sub: Subscription, msg) -> None:
+        if sub.terminated:
+            return
+        try:
+            sub.queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            self.reset(sub)
+            return
+        n = len(msg.response)
+        size = msg.ByteSize()
+        self.total_messages += 1
+        self.total_deltas += n
+        self.total_bytes += size
+        self._tick_messages += 1
+        self._tick_deltas += n
+        self._tick_bytes += size
+
+    # -- introspection -------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._subs)
+
+    def take_tick_stats(self) -> dict:
+        """Per-tick counters for the flight recorder; resets on read."""
+        out = {
+            "subscribers": len(self._subs),
+            "deltas_pushed": self._tick_deltas,
+            "push_bytes": self._tick_bytes,
+            "messages": self._tick_messages,
+        }
+        self._tick_deltas = self._tick_bytes = self._tick_messages = 0
+        return out
+
+    def status(self) -> dict:
+        return {
+            "subscribers": len(self._subs),
+            "by_band": {
+                str(b): n for b, n in sorted(self._band_counts.items())
+            },
+            "max_streams_per_band": self.max_streams_per_band,
+            "seq": self._seq,
+            "messages_total": self.total_messages,
+            "deltas_total": self.total_deltas,
+            "bytes_total": self.total_bytes,
+            "resets_total": self.total_resets,
+        }

@@ -57,6 +57,7 @@ __all__ = [
     "BatchTickAdapter",
     "place",
     "landed_rows",
+    "landed_changed",
     "bf16_exact",
     "compact_index_dtype",
     "ceil_to",
@@ -66,10 +67,13 @@ __all__ = [
 # phase_s; bench.py, /debug/status, and the flight recorder all read it).
 # "staging" is the host-side assembly of this tick's upload blocks —
 # split from "upload" (the device placement) so the admission-fused
-# pipeline stage is triaged like the others.
+# pipeline stage is triaged like the others. "delta" is the host tail of
+# delivered-grant delta extraction (streaming lease push): resolving the
+# device-compared changed-row mask to engine rids — the mask itself
+# lands with the delivery download.
 PHASES = (
     "sweep", "drain", "config", "pack", "staging", "upload", "solve",
-    "download", "apply", "rebuild",
+    "download", "apply", "delta", "rebuild",
 )
 
 
@@ -114,6 +118,26 @@ def landed_rows(handle: "TickHandle") -> np.ndarray:
     if not parts:
         return np.zeros((0, gets.shape[-1]))
     return np.concatenate(parts)
+
+
+def landed_changed(handle: "TickHandle") -> "np.ndarray | None":
+    """Land a tick's changed-row mask into a [n_sel] host bool array
+    (None when the engine does not track deltas). Mesh masks land as
+    [n_dev, Sb] per-shard blocks whose real slots concatenate in
+    shard-major order — exactly like landed_rows."""
+    if handle.changed is None:
+        return None
+    ch = np.asarray(handle.changed)
+    if handle.shard_counts is None:
+        return ch[: handle.n_sel].astype(bool)
+    parts = [
+        ch[d, : int(c)]
+        for d, c in enumerate(handle.shard_counts)
+        if int(c)
+    ]
+    if not parts:
+        return np.zeros(0, bool)
+    return np.concatenate(parts).astype(bool)
 
 
 try:
@@ -165,6 +189,12 @@ class TickHandle:
     # windows folded in, rows served from the window-time pack cache.
     fused_windows: int = 0
     fused_rows: int = 0
+    # Delta tracking (streaming lease push): device bool mask over the
+    # delivery slots — True where the delivered grants differ from the
+    # resident previous-grants table. Single device: [Sb]; mesh:
+    # [n_dev, Sb] per-shard blocks aligned with `out`. None when the
+    # engine does not track deltas.
+    changed: object = None
 
 
 def idle_handle(now: float) -> TickHandle:
@@ -480,6 +510,16 @@ class TickEngineBase:
         self._config = ConfigTable(
             self._dtype, config_put or self._put_rows
         )
+        # Delivered-grant delta tracking (streaming lease push): when
+        # enabled (and the engine supports it — see supports_delta), the
+        # tick executable compares each delivered row against a resident
+        # previous-grants table and the collect tail accumulates the
+        # rids whose delivered values moved; the server's stream fanout
+        # drains them (take_changed_rids) so only subscribers of rows
+        # that actually changed pay a decide+serialize.
+        self._track_deltas = False
+        self._changed_lock = threading.Lock()
+        self._changed_rids: set = set()  # guarded-by: self._changed_lock
         # Admission-fused staging (narrow path); attach_staging() wires
         # it. None keeps the round-trip pack on every tick.
         self._staging: "FusedStaging | None" = None
@@ -515,6 +555,45 @@ class TickEngineBase:
         if self._staging is None:
             self._staging = FusedStaging(self._engine)
         return self._staging
+
+    # Engines that keep a resident previous-grants table and compare
+    # delivered rows on device set this True (the narrow resident
+    # solver); others return False from enable_delta_tracking and the
+    # caller must treat every tick as potentially-changed.
+    supports_delta = False
+
+    def enable_delta_tracking(self) -> bool:
+        """Turn on delivered-grant delta extraction for the streaming
+        lease push. Returns True when this engine supports it; the next
+        dispatch rebuilds so the previous-grants table exists.
+        Idempotent; there is no disable (the table dies with the
+        solver)."""
+        if not self.supports_delta:
+            return False
+        if not self._track_deltas:
+            self._track_deltas = True
+            self._tick_fns.clear()
+            self._invalidate_layout()
+        return True
+
+    def _invalidate_layout(self) -> None:
+        """Subclass hook: drop the device tables so the next dispatch
+        rebuilds (enable_delta_tracking needs the prev-grants table
+        allocated alongside them)."""
+
+    @property
+    def delta_tracking(self) -> bool:
+        return self._track_deltas
+
+    def take_changed_rids(self) -> list:
+        """Drain the engine rids whose delivered grants changed since
+        they were last delivered (accumulated at collect). Thread-safe:
+        collect may run in an executor while the fanout drains on the
+        event loop."""
+        with self._changed_lock:
+            out = list(self._changed_rids)
+            self._changed_rids.clear()
+        return out
 
     @property
     def staging(self) -> "FusedStaging | None":
@@ -638,11 +717,26 @@ class TickEngineBase:
             return 0
         ph = PhaseRecorder(self.component, self.phase_s)
         # Parts were split (and their async copies started) at
-        # dispatch; land them in order into one buffer.
+        # dispatch; land them in order into one buffer. The changed-row
+        # mask (delta tracking) rides the same download lap — it is a
+        # delivery byte like the grants themselves.
         gets = landed_rows(handle)
+        changed = landed_changed(handle)
         ph.lap("download")
         applied = self._apply_grants(handle, gets)
         ph.lap("apply")
+        if changed is not None:
+            # Resolve the mask to engine rids for the stream fanout
+            # (rid -1 is the reserved padding row — never a real
+            # resource). Host-side numpy only; the device compare and
+            # its download already happened.
+            if changed.any():
+                rids = handle.rids[changed]
+                rids = rids[rids >= 0]
+                if len(rids):
+                    with self._changed_lock:
+                        self._changed_rids.update(int(r) for r in rids)
+            ph.lap("delta")
         self.ticks += 1
         self.last_tick_seconds = self._clock() - handle.dispatched_at
         return applied

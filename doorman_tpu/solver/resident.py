@@ -99,6 +99,7 @@ class ResidentDenseSolver(TickEngineBase):
     """
 
     component = "resident"
+    supports_delta = True
 
     def __init__(
         self,
@@ -133,6 +134,13 @@ class ResidentDenseSolver(TickEngineBase):
 
         # Device tables (donated through each tick executable).
         self._wants = self._has = self._sub = self._act = None
+        # Resident previous-DELIVERED-grants table (delta tracking for
+        # the streaming lease push): what the store of record last saw
+        # for each row, kept on device so the per-tick compare against
+        # fresh grants never re-ships full rows to the host — only the
+        # [Sb]-bool changed mask rides the delivery download. None until
+        # enable_delta_tracking() + the next rebuild.
+        self._prev = None
         # FAIR_SHARE row indices (device, padded; see solver.lanes
         # waterfill_level_compact) — rebuilt when the config's kind
         # vector moves.
@@ -207,12 +215,27 @@ class ResidentDenseSolver(TickEngineBase):
         self._has = self._put_rows(h.astype(dtype))
         self._sub = self._put_rows(s.astype(dtype))
         self._act = self._put_rows(act.astype(bool))
+        # The previous-grants table starts at the store's current has:
+        # the first tracked tick's changed set is exactly the rows whose
+        # fresh solve moves the store of record. Kept in the download
+        # dtype so the compare sees the very bytes the host would.
+        self._prev = (
+            self._put_rows(h.astype(self._out_dtype))
+            if self._track_deltas
+            else None
+        )
         self._uploaded_versions = versions
         self._config.reset(self._Rp)
         self._fair_kinds = None
         self._refresh_config(rows, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
+
+    def _invalidate_layout(self) -> None:
+        # Force a rebuild at the next dispatch so the prev-grants table
+        # is allocated alongside the demand tables.
+        self._wants = None
+        self._prev = None
 
     def _needs_rebuild(self, resources: List[Resource]) -> bool:
         # Full identity scan every tick: a mid-list replacement with
@@ -268,7 +291,8 @@ class ResidentDenseSolver(TickEngineBase):
         shard-LOCAL; padded scatter slots carry the out-of-range index
         Rl and drop, padded gather slots repeat a valid index and are
         sliced off at collect."""
-        key = (Da, Df, Sb, self._kfill, lanes)
+        track = self._track_deltas
+        key = (Da, Df, Sb, self._kfill, lanes, track)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -296,8 +320,8 @@ class ResidentDenseSolver(TickEngineBase):
         axes = self._meshrows.axes
         want_fair = int(AlgoKind.FAIR_SHARE) in lanes
 
-        def body(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
-                 cap, kind, learn, statc):
+        def _core(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
+                  cap, kind, learn, statc):
             # Per-shard staged blocks arrive as [1, ...]; tables and
             # per-row config as this shard's [Rl, ...] block.
             idx = idx[0]
@@ -328,35 +352,77 @@ class ResidentDenseSolver(TickEngineBase):
                 gets, sel_idx, axis=0, mode="clip",
                 indices_are_sorted=True,
             )[:, :kfill].astype(out_dtype)
-            return wants, gets, sub, act, out[None]
+            return wants, gets, sub, act, out, sel_idx
 
         rowk = P(axes, None)
         row = P(axes)
         dev2 = P(axes, None, None)
-        mapped = shard_map(
-            body,
-            mesh=self._mesh,
-            in_specs=(
-                rowk, rowk, rowk, rowk,  # tables
-                rowk,  # fused idx [n_dev, Da+Df+Sb]
-                dev2,  # a_w [n_dev, Da, kfill]
-                P(axes, None, None, None),  # f_block [n_dev, 2, Df, kfill]
-                dev2,  # f_act [n_dev, Df, kfill]
-                rowk,  # fair rows [n_dev, Fb] (shard-local)
-                row, row, row, row,  # per-row config
-            ),
-            out_specs=(rowk, rowk, rowk, rowk, dev2),
+        in_specs_tail = (
+            rowk,  # fused idx [n_dev, Da+Df+Sb]
+            dev2,  # a_w [n_dev, Da, kfill]
+            P(axes, None, None, None),  # f_block [n_dev, 2, Df, kfill]
+            dev2,  # f_act [n_dev, Df, kfill]
+            rowk,  # fair rows [n_dev, Fb] (shard-local)
+            row, row, row, row,  # per-row config
         )
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def tick(*args):
-            return mapped(*args)
+        if track:
+            # Delta tracking, shard-local: every shard compares its own
+            # delivery slots against its slice of the prev-grants table
+            # (padded gather slots repeat a real index — their compare
+            # result is sliced off with them at collect).
+            def body(wants, has, sub, act, prev, idx, a_w, f_block,
+                     f_act, fair, cap, kind, learn, statc):
+                wants, gets, sub, act, out, sel_idx = _core(
+                    wants, has, sub, act, idx, a_w, f_block, f_act,
+                    fair, cap, kind, learn, statc,
+                )
+                prev_sel = jnp.take(
+                    prev, sel_idx, axis=0, mode="clip",
+                    indices_are_sorted=True,
+                )[:, :kfill]
+                changed = (out != prev_sel).any(axis=1)
+                prev = prev.at[sel_idx, :kfill].set(out, mode="drop")
+                return wants, gets, sub, act, prev, out[None], changed[None]
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(
+                    rowk, rowk, rowk, rowk, rowk, dev2, P(axes, None),
+                ),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(*args):
+                return mapped(*args)
+        else:
+            def body(wants, has, sub, act, idx, a_w, f_block, f_act,
+                     fair, cap, kind, learn, statc):
+                wants, gets, sub, act, out, _ = _core(
+                    wants, has, sub, act, idx, a_w, f_block, f_act,
+                    fair, cap, kind, learn, statc,
+                )
+                return wants, gets, sub, act, out[None]
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(rowk, rowk, rowk, rowk, dev2),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(*args):
+                return mapped(*args)
 
         self._tick_fns[key] = tick
         return tick
 
     def _tick_fn(self, Da: int, Df: int, Sb: int, lanes: frozenset):
-        key = (Da, Df, Sb, self._kfill, lanes)
+        track = self._track_deltas
+        key = (Da, Df, Sb, self._kfill, lanes, track)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -387,9 +453,8 @@ class ResidentDenseSolver(TickEngineBase):
         # subclients) ship everything. One fused int32 index upload
         # carries all three index sets — the tunnel link charges per
         # transfer op, not just per byte.
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def tick(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
-                 cap, kind, learn, statc):
+        def _core(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
+                  cap, kind, learn, statc):
             a_idx = idx[:Da]
             f_idx = idx[Da:Da + Df]
             sel_idx = idx[Da + Df:]
@@ -414,7 +479,32 @@ class ResidentDenseSolver(TickEngineBase):
             # (learning rows replay has, so the chain preserves them;
             # inactive lanes solve to 0).
             out = gets[sel_idx, :kfill].astype(out_dtype)
-            return wants, gets, sub, act, out
+            return wants, gets, sub, act, out, sel_idx
+
+        if track:
+            # Delta tracking: compare the delivered rows against the
+            # resident previous-grants table ON DEVICE and update it in
+            # place (donated like the demand tables); the host downloads
+            # a [Sb] bool mask, never the prev rows.
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(wants, has, sub, act, prev, idx, a_w, f_block,
+                     f_act, fair, cap, kind, learn, statc):
+                wants, gets, sub, act, out, sel_idx = _core(
+                    wants, has, sub, act, idx, a_w, f_block, f_act,
+                    fair, cap, kind, learn, statc,
+                )
+                changed = (out != prev[sel_idx, :kfill]).any(axis=1)
+                prev = prev.at[sel_idx, :kfill].set(out)
+                return wants, gets, sub, act, prev, out, changed
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(wants, has, sub, act, idx, a_w, f_block, f_act,
+                     fair, cap, kind, learn, statc):
+                wants, gets, sub, act, out, _ = _core(
+                    wants, has, sub, act, idx, a_w, f_block, f_act,
+                    fair, cap, kind, learn, statc,
+                )
+                return wants, gets, sub, act, out
 
         self._tick_fns[key] = tick
         return tick
@@ -642,13 +732,24 @@ class ResidentDenseSolver(TickEngineBase):
         ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_act_d = staged
         cfg = self._config
-        (
-            self._wants, self._has, self._sub, self._act, out
-        ) = tick(
-            self._wants, self._has, self._sub, self._act,
-            idx_d, a_w_d, f_block_d, f_act_d, fair_d,
-            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-        )
+        changed_d = None
+        if self._track_deltas:
+            (
+                self._wants, self._has, self._sub, self._act,
+                self._prev, out, changed_d
+            ) = tick(
+                self._wants, self._has, self._sub, self._act, self._prev,
+                idx_d, a_w_d, f_block_d, f_act_d, fair_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+        else:
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act,
+                idx_d, a_w_d, f_block_d, f_act_d, fair_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
         # Start the grant download as SEVERAL async streams: the
         # tunneled device link only reaches full bandwidth with
         # overlapping copies in flight, and a single whole-slab copy
@@ -674,6 +775,7 @@ class ResidentDenseSolver(TickEngineBase):
             dispatched_at=now,
             fused_windows=fwin,
             fused_rows=rows_hit,
+            changed=changed_d,
         )
 
     def _stage_mesh(self, order, is_full, w, h, s, act, sel, now, ph,
@@ -766,13 +868,24 @@ class ResidentDenseSolver(TickEngineBase):
         ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_a_d = staged
         cfg = self._config
-        (
-            self._wants, self._has, self._sub, self._act, out
-        ) = tick(
-            self._wants, self._has, self._sub, self._act,
-            idx_d, a_w_d, f_block_d, f_a_d, fair_d,
-            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-        )
+        changed_d = None
+        if self._track_deltas:
+            (
+                self._wants, self._has, self._sub, self._act,
+                self._prev, out, changed_d
+            ) = tick(
+                self._wants, self._has, self._sub, self._act, self._prev,
+                idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+        else:
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act,
+                idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
         out = start_sharded_download(out)
         ph.lap("solve")
         self.last_fused = {"windows": fwin, "rows": rows_hit}
@@ -787,6 +900,7 @@ class ResidentDenseSolver(TickEngineBase):
             shard_counts=counts_sel,
             fused_windows=fwin,
             fused_rows=rows_hit,
+            changed=changed_d,
         )
 
     def _apply_grants(self, handle: TickHandle, gets: np.ndarray) -> int:

@@ -175,10 +175,14 @@ def test_backoff_full_jitter_opt_in():
 
 def test_shed_matrix():
     assert sheddable("GetCapacity")
+    # Stream establishment is sheddable (a refused subscriber keeps
+    # polling); the three never-shed rows stay never-shed.
+    assert sheddable("WatchCapacity")
     for method in ("ReleaseCapacity", "GetServerCapacity", "Discovery"):
         assert not sheddable(method)
     assert set(SHED_MATRIX) == {
-        "GetCapacity", "GetServerCapacity", "ReleaseCapacity", "Discovery"
+        "GetCapacity", "WatchCapacity", "GetServerCapacity",
+        "ReleaseCapacity", "Discovery",
     }
 
 

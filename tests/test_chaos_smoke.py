@@ -56,6 +56,40 @@ def test_master_flap_fails_over_without_split_brain(verdicts):
     assert ["s1"] in [m for _, m in timeline]
 
 
+def test_master_flap_streaming_leg(verdicts):
+    """The streaming subscriber rides the flap: establish + snapshot
+    push at t0, SILENT at steady state (the RPC win — no poll events
+    while the stream is healthy), terminal mastership redirect at the
+    flip, poll fallback while masterless, re-establishment once a
+    master answers — with every lease-window invariant intact (the
+    plan-level ok covers the stream client too)."""
+    v = verdicts["master_flap"]
+    flap_tick = next(
+        e[0] for e in v["event_log"] if e[1] == "fault"
+    )
+    streams = [e for e in v["event_log"] if e[1] == "stream"]
+    assert streams, "no streaming leg in master_flap"
+    by_tick = {e[0]: e[3] for e in streams}
+    # Establishment with the snapshot push, before the fault.
+    assert by_tick[0] == "establish" and streams[0][4] == 1
+    # Healthy steady state is SILENT: no poll events before the flap
+    # after establishment (pure pushes at most).
+    for e in streams:
+        if 0 < e[0] < flap_tick:
+            assert "poll" not in e[3], f"steady-state poll at {e}"
+    # The flip terminates the stream with a mastership redirect and
+    # the client degrades to polling.
+    assert any(
+        "redirect" in ev and "poll" in ev
+        for t, ev in by_tick.items() if t >= flap_tick
+    ), "no redirect+poll fallback at the flip"
+    # And a later clean re-establishment (snapshot push again).
+    assert any(
+        e[3] == "establish" and e[0] > flap_tick and e[4] >= 1
+        for e in streams
+    ), "stream never re-established after the flap"
+
+
 def test_master_flap_warm_restores_instead_of_relearning(verdicts):
     v = verdicts["master_flap_warm"]
     plan = get_plan("master_flap_warm")

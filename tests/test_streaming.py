@@ -1,0 +1,769 @@
+"""Streaming lease push (WatchCapacity): the parity pin + the contract.
+
+The parity pin (ISSUE 9): for any churn schedule, the lease sequence a
+streaming client observes must be byte-identical to the change-filtered
+sequence the same client would read by polling every tick. The harness
+runs TWO identically-configured servers on one virtual clock — the
+"poll side" serves a client that polls after every tick, the "stream
+side" serves the same client as a WatchCapacity subscriber — drives an
+identical churn schedule against both, and compares serialized
+ResourceResponse rows: every pushed row must equal, byte for byte, the
+poll row of the same tick, and the pushed sequence must be exactly the
+polls' changed-subsequence (capacity filter). Runs over the Python and
+native store engines (the native side exercises the resident tick's
+device-extracted delta set), with a mid-run mastership flip and a
+disconnect + resume-from-seq reconnect.
+
+Contract tests: admission AIMD shed + per-band stream caps on
+establishment (RESOURCE_EXHAUSTED + retry-after trailing metadata),
+UNIMPLEMENTED poll fallback when stream push is off, the quiet-stream
+expiry-margin safety poll, slow-consumer reset, and seq monotonicity.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.client import Client
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.proto import doorman_stream_pb2 as spb
+from doorman_tpu.proto.grpc_api import CapacityStub
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+CONFIG = """
+resources:
+- identifier_glob: prop
+  capacity: 100
+  safe_capacity: 3
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+- identifier_glob: "*"
+  capacity: 80
+  algorithm: {kind: FAIR_SHARE, lease_length: 60, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
+RESOURCES = ("prop", "fair")
+# Watcher priorities per resource: mixed bands on one stream.
+WATCH_PRIO = {"prop": 2, "fair": 0}
+# (tick, churner, resource, wants) — the shared schedule. Ticks 6 and
+# 10/12 are the flip and the disconnect window (see the parity test).
+CHURN = [
+    (1, "c1", "prop", 70.0),
+    (2, "c2", "fair", 55.0),
+    (3, "c1", "prop", 20.0),
+    (3, "c2", "fair", 90.0),
+    (5, "c3", "prop", 40.0),
+    (8, "c1", "prop", 75.0),
+    (9, "c2", "fair", 10.0),
+    (11, "c3", "prop", 5.0),
+    (13, "c1", "prop", 60.0),
+]
+TOTAL_TICKS = 15
+FLIP_TICK = 6
+DISCONNECT_TICK = 10
+RECONNECT_TICK = 12
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class StreamReader:
+    """Reads a WatchCapacity stream without the wait_for(call.read())
+    trap: cancelling a pending read cancels the whole RPC, so the
+    pending read task is kept across timeouts instead."""
+
+    def __init__(self, call):
+        self.call = call
+        self._pending = None
+
+    async def read(self, timeout=5.0):
+        if self._pending is None:
+            self._pending = asyncio.ensure_future(self.call.read())
+        done, _ = await asyncio.wait({self._pending}, timeout=timeout)
+        if not done:
+            return None
+        task, self._pending = self._pending, None
+        return task.result()
+
+    async def read_exactly(self, n, timeout=5.0):
+        out = []
+        for _ in range(n):
+            msg = await self.read(timeout)
+            assert msg is not None and msg is not grpc.aio.EOF, (
+                f"expected {n} pushed messages, got {len(out)}"
+            )
+            out.append(msg)
+        return out
+
+    def cancel(self):
+        if self._pending is not None:
+            self._pending.cancel()
+            self._pending = None
+        self.call.cancel()
+
+
+async def make_server(clock, *, native_store, stream_push,
+                      tick_interval=1.0, config_yaml=CONFIG, **kwargs):
+    server = CapacityServer(
+        "srv", TrivialElection(), mode="batch",
+        tick_interval=tick_interval, minimum_refresh_interval=0.0,
+        clock=clock, native_store=native_store, stream_push=stream_push,
+        **kwargs,
+    )
+    port = await server.start(0, host="127.0.0.1")
+    await server.load_config(parse_yaml_config(config_yaml))
+    await asyncio.sleep(0)  # election callbacks land
+    server.current_master = f"127.0.0.1:{port}"
+    # The harness owns the tick cadence.
+    for task in server._tasks:
+        task.cancel()
+    server._tasks.clear()
+    return server, f"127.0.0.1:{port}"
+
+
+def watch_request(client_id, leases, resume_seq=0):
+    req = spb.WatchCapacityRequest(client_id=client_id,
+                                   resume_seq=resume_seq)
+    for rid in RESOURCES:
+        rr = req.resource.add()
+        rr.resource_id = rid
+        rr.priority = WATCH_PRIO[rid]
+        rr.wants = 30.0
+        if leases.get(rid) is not None:
+            rr.has.CopyFrom(leases[rid])
+    return req
+
+
+class PollSide:
+    """The watcher as a poll-every-tick client (raw stub, has carried)."""
+
+    def __init__(self, stub):
+        self.stub = stub
+        self.leases = {}
+        # resource -> list of serialized changed rows, filtered by what
+        # a client OBSERVES of a lease: (capacity, safe_capacity,
+        # refresh_interval) — expiry advances every poll by design and
+        # is excluded (it is exactly what the push path saves).
+        self.changed = {rid: [] for rid in RESOURCES}
+        self.keys = {}  # resource -> last observed key
+        self.rows = {}  # resource -> latest serialized row
+
+    async def poll(self, record=True):
+        req = pb.GetCapacityRequest(client_id="w")
+        for rid in RESOURCES:
+            rr = req.resource.add()
+            rr.resource_id = rid
+            rr.priority = WATCH_PRIO[rid]
+            rr.wants = 30.0
+            if self.leases.get(rid) is not None:
+                rr.has.CopyFrom(self.leases[rid])
+        out = await self.stub.GetCapacity(req)
+        assert not out.HasField("mastership"), "unexpected redirect"
+        polled = {}
+        for row in out.response:
+            rid = row.resource_id
+            key = (
+                row.gets.capacity, row.safe_capacity,
+                row.gets.refresh_interval,
+            )
+            if record and key != self.keys.get(rid):
+                self.changed[rid].append(row.SerializeToString())
+            self.keys[rid] = key
+            self.rows[rid] = row.SerializeToString()
+            lease = pb.Lease()
+            lease.CopyFrom(row.gets)
+            self.leases[rid] = lease
+            polled[rid] = row
+        return polled
+
+
+async def drive_churn(tick, stubs, leases_by_stub):
+    """Apply this tick's churn rows identically against every server."""
+    for at, cid, rid, wants in CHURN:
+        if at != tick:
+            continue
+        for stub in stubs:
+            leases = leases_by_stub[id(stub)]
+            req = pb.GetCapacityRequest(client_id=cid)
+            rr = req.resource.add()
+            rr.resource_id = rid
+            rr.priority = 1
+            rr.wants = wants
+            if leases.get((cid, rid)) is not None:
+                rr.has.CopyFrom(leases[(cid, rid)])
+            out = await stub.GetCapacity(req)
+            lease = pb.Lease()
+            lease.CopyFrom(out.response[0].gets)
+            leases[(cid, rid)] = lease
+
+
+async def reregister_after_flip(tick, stubs, leases_by_stub):
+    """A flip wipes all state; every churner re-reports its wants (the
+    reference's wipe-and-relearn contract), in identical order."""
+    current = {}
+    for at, cid, rid, wants in CHURN:
+        if at < tick:
+            current[(cid, rid)] = wants
+    for stub in stubs:
+        leases = leases_by_stub[id(stub)]
+        for (cid, rid), wants in sorted(current.items()):
+            req = pb.GetCapacityRequest(client_id=cid)
+            rr = req.resource.add()
+            rr.resource_id = rid
+            rr.priority = 1
+            rr.wants = wants
+            if leases.get((cid, rid)) is not None:
+                rr.has.CopyFrom(leases[(cid, rid)])
+            out = await stub.GetCapacity(req)
+            lease = pb.Lease()
+            lease.CopyFrom(out.response[0].gets)
+            leases[(cid, rid)] = lease
+
+
+@pytest.mark.parametrize(
+    "native_store",
+    [
+        False,
+        pytest.param(
+            True,
+            marks=pytest.mark.skipif(
+                not native.native_available(),
+                reason="native engine unavailable",
+            ),
+        ),
+    ],
+    ids=["python-store", "native-store"],
+)
+def test_push_poll_parity(native_store):
+    """The parity pin: pushed rows == the polls' changed-subsequence,
+    byte for byte, across churn, a mastership flip, and a
+    resume-from-seq reconnect (Python + native stores, mixed bands)."""
+
+    async def body():
+        t = [1000.0]
+        clock = lambda: t[0]  # noqa: E731
+        pserver, paddr = await make_server(
+            clock, native_store=native_store, stream_push=False
+        )
+        sserver, saddr = await make_server(
+            clock, native_store=native_store, stream_push=True
+        )
+        pch = grpc.aio.insecure_channel(paddr)
+        sch = grpc.aio.insecure_channel(saddr)
+        try:
+            pstub, sstub = CapacityStub(pch), CapacityStub(sch)
+            churn_leases = {id(pstub): {}, id(sstub): {}}
+            poll = PollSide(pstub)
+
+            # Establishment at t0: first poll on the poll side, stream
+            # snapshot on the stream side — byte-identical full rows.
+            await poll.poll()
+            stream_leases = {}
+            last_seq = 0
+            pushed = {rid: [] for rid in RESOURCES}
+
+            def apply_push(msg):
+                nonlocal last_seq
+                assert msg.seq > last_seq or msg.snapshot
+                last_seq = int(msg.seq)
+                for row in msg.response:
+                    pushed[row.resource_id].append(row.SerializeToString())
+                    lease = pb.Lease()
+                    lease.CopyFrom(row.gets)
+                    stream_leases[row.resource_id] = lease
+
+            reader = StreamReader(
+                sstub.WatchCapacity(watch_request("w", stream_leases))
+            )
+            snap = await reader.read()
+            assert snap.snapshot
+            assert sorted(r.resource_id for r in snap.response) == sorted(
+                RESOURCES
+            )
+            apply_push(snap)
+            for rid in RESOURCES:
+                assert pushed[rid] == poll.changed[rid], rid
+
+            registry = sserver._streams
+
+            async def stream_tick():
+                before = registry.total_messages
+                await sserver.tick_once()
+                for msg in await reader.read_exactly(
+                    registry.total_messages - before
+                ):
+                    apply_push(msg)
+
+            disconnected = False
+            for tick in range(1, TOTAL_TICKS):
+                if tick == FLIP_TICK:
+                    # Mid-stream mastership flip on both sides: the
+                    # stream must end with a terminal redirect; the
+                    # subscriber re-establishes with its resume token.
+                    await pserver._on_is_master(False)
+                    await sserver._on_is_master(False)
+                    term = await reader.read()
+                    assert term.HasField("mastership")
+                    reader.cancel()
+                    await pserver._on_is_master(True)
+                    await sserver._on_is_master(True)
+                    await reregister_after_flip(
+                        tick, (pstub, sstub), churn_leases
+                    )
+                    # Poll side: one poll (this is the tick's poll);
+                    # stream side: re-establish with the resume token.
+                    before = registry.total_messages
+                    reader = StreamReader(sstub.WatchCapacity(
+                        watch_request("w", stream_leases,
+                                      resume_seq=last_seq)
+                    ))
+                    resumed = await reader.read()
+                    assert resumed.snapshot
+                    apply_push(resumed)
+                    await poll.poll()
+                    for rid in RESOURCES:
+                        assert pushed[rid] == poll.changed[rid], (
+                            f"flip parity broke for {rid}"
+                        )
+                    continue
+                if tick == DISCONNECT_TICK:
+                    # Drop the stream (no release — the subscription
+                    # just vanishes); churn keeps landing on both sides.
+                    reader.cancel()
+                    await asyncio.sleep(0.05)  # server sees the cancel
+                    disconnected = True
+                if tick == RECONNECT_TICK:
+                    disconnected = False
+                await drive_churn(tick, (pstub, sstub), churn_leases)
+                t[0] += 1.0
+                await pserver.tick_once()
+                if disconnected:
+                    await sserver.tick_once()
+                    await poll.poll()
+                    continue
+                if tick == RECONNECT_TICK:
+                    await sserver.tick_once()
+                    await poll.poll()
+                    # Resume-from-seq reconnect: the first message must
+                    # carry exactly the net-changed rows, each byte-
+                    # identical to this tick's poll row.
+                    reader = StreamReader(sstub.WatchCapacity(
+                        watch_request("w", stream_leases,
+                                      resume_seq=last_seq)
+                    ))
+                    resumed = await reader.read()
+                    assert resumed.snapshot
+                    for row in resumed.response:
+                        assert (
+                            row.SerializeToString()
+                            == poll.rows[row.resource_id]
+                        ), f"resume row for {row.resource_id} diverged"
+                        assert (
+                            row.gets.capacity
+                            != stream_leases[row.resource_id].capacity
+                        ), "resume pushed an unchanged row"
+                    # Rebase the filtered sequences across the gap: the
+                    # stream legitimately never observed intra-gap
+                    # flapping, so both sides restart from the resumed
+                    # state.
+                    apply_push(resumed)
+                    for rid in RESOURCES:
+                        poll.changed[rid] = list(pushed[rid])
+                    continue
+                await stream_tick()
+                await poll.poll()
+                for rid in RESOURCES:
+                    assert pushed[rid] == poll.changed[rid], (
+                        f"parity broke for {rid} at tick {tick}: "
+                        f"{len(pushed[rid])} pushed vs "
+                        f"{len(poll.changed[rid])} polled changes"
+                    )
+
+            # The schedule must have exercised real pushes (not a
+            # vacuous run) and the dedup (fewer pushes than ticks).
+            total = sum(len(v) for v in pushed.values())
+            assert total >= 6, f"schedule produced only {total} changes"
+            assert total < TOTAL_TICKS * len(RESOURCES)
+            reader.cancel()
+        finally:
+            await pch.close()
+            await sch.close()
+            await pserver.stop()
+            await sserver.stop()
+
+    run(body())
+
+
+def test_stream_cap_and_admission_shed():
+    """Per-band stream caps and the AIMD gate both refuse establishment
+    with RESOURCE_EXHAUSTED + a doorman-retry-after trailing hint; a
+    different band is unaffected by another band's cap."""
+
+    async def body():
+        from doorman_tpu.admission import Admission
+
+        t = [500.0]
+        server, addr = await make_server(
+            lambda: t[0], native_store=False, stream_push=True,
+            max_streams_per_band=1,
+            admission=Admission(coalesce_window=0.0),
+        )
+        ch = grpc.aio.insecure_channel(addr)
+        try:
+            stub = CapacityStub(ch)
+
+            def req(cid, prio, resume=0):
+                r = spb.WatchCapacityRequest(client_id=cid)
+                rr = r.resource.add()
+                rr.resource_id = "prop"
+                rr.priority = prio
+                rr.wants = 10.0
+                return r
+
+            r1 = StreamReader(stub.WatchCapacity(req("a", 0)))
+            assert (await r1.read()).snapshot
+            # Same band: capped.
+            r2 = StreamReader(stub.WatchCapacity(req("b", 0)))
+            with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                await r2.read()
+            e = excinfo.value
+            assert e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            hints = [
+                float(v) for k, v in (e.trailing_metadata() or ())
+                if k == "doorman-retry-after"
+            ]
+            assert hints and hints[0] > 0
+            # Another band: admitted.
+            r3 = StreamReader(stub.WatchCapacity(req("c", 1)))
+            assert (await r3.read()).snapshot
+            # The AIMD gate sheds establishment once the level drops
+            # (band 0 extinguishes first while band 1 exists).
+            server._admission.controller.level = 0.01
+            r4 = StreamReader(stub.WatchCapacity(req("d", 0)))
+            with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                await r4.read()
+            assert (
+                excinfo.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            )
+            tallies = server._admission.tallies
+            assert any(
+                m == "WatchCapacity" and c["shed"] > 0
+                for (m, _b), c in tallies.items()
+            )
+            assert server.status()["streams"]["by_band"] == {
+                "0": 1, "1": 1,
+            }
+            r1.cancel()
+            r3.cancel()
+        finally:
+            await ch.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_unimplemented_falls_back_to_poll():
+    """A stream-mode client against a server WITHOUT stream push keeps
+    working: WatchCapacity answers UNIMPLEMENTED and the client's poll
+    fallback serves capacity exactly as before."""
+
+    async def body():
+        server, addr = await make_server(
+            lambda: __import__("time").time(),
+            native_store=False, stream_push=False, tick_interval=0.05,
+        )
+        # The harness cancelled the tick loop; restart it for this
+        # real-time test.
+        server._tasks.append(asyncio.create_task(server._tick_loop()))
+        try:
+            client = await Client.connect(
+                addr, "w", stream=True, minimum_refresh_interval=0.0
+            )
+            res = await client.resource("prop", 25.0)
+            value = await asyncio.wait_for(res.capacity().get(), 10)
+            assert value == 25.0
+            # The stream probe backed off instead of spinning.
+            assert client._stream_retry_at > client._clock()
+            await client.close()
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+SHORT_LEASE_CONFIG = """
+resources:
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 5, refresh_interval: 1,
+              learning_mode_duration: 0}
+"""
+
+
+def test_quiet_stream_polls_only_at_expiry_margin():
+    """The steady-state contract both ways: a healthy-but-quiet stream
+    is trusted PAST the refresh interval — no poll, which is the whole
+    RPC reduction — and degrades to the safety poll once quiet reaches
+    the lease-expiry margin (expiry - refresh_interval), so the lease
+    is re-observed before it can lapse even if the stream died without
+    an error. Checkpoints anchor to the granted lease's actual expiry
+    so loaded boxes don't turn the margins into flakes."""
+
+    async def body():
+        import time as _time
+
+        server, addr = await make_server(
+            _time.time, native_store=False, stream_push=True,
+            tick_interval=0.05, config_yaml=SHORT_LEASE_CONFIG,
+        )
+        server._tasks.append(asyncio.create_task(server._tick_loop()))
+        polls = []
+        orig = server.on_request
+        server.on_request = lambda m, d, e: (
+            polls.append(m) if m == "GetCapacity" else None,
+            orig(m, d, e),
+        )
+        try:
+            client = await Client.connect(
+                addr, "w", stream=True, minimum_refresh_interval=0.0
+            )
+            res = await client.resource("prop", 25.0)
+            await asyncio.wait_for(res.capacity().get(), 10)
+            baseline = len(polls)
+            # Quiet for SEVERAL refresh intervals (1s each, lease 5s):
+            # a polling client would have refreshed repeatedly; the
+            # stream client must not until the expiry margin. Check
+            # 2s before expiry — a full second clear of the margin
+            # poll due at expiry - refresh_interval.
+            expiry = float(res.lease.expiry_time)
+            await asyncio.sleep(max(0.0, expiry - 2.0 - _time.time()))
+            assert len(polls) == baseline, (
+                "stream polled while the lease had margin"
+            )
+            # Quiet INTO the margin: the safety poll fires (due at
+            # expiry-1), and the healthy stream stays open through it.
+            await asyncio.sleep(max(0.0, expiry + 1.5 - _time.time()))
+            assert len(polls) > baseline, (
+                "no safety poll at the lease-expiry margin"
+            )
+            assert len(server._streams) == 1, "the quiet stream was dropped"
+            await client.close()
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_stream_storm_driver():
+    """loadtest.storm --stream: workers hold WatchCapacity streams and
+    count pushes; establishments beyond the per-band cap are shed with
+    retry-after, honored before re-establishing."""
+
+    async def body():
+        import time as _time
+
+        from doorman_tpu.loadtest.storm import run_storm
+
+        server, addr = await make_server(
+            _time.time, native_store=False, stream_push=True,
+            tick_interval=0.05, max_streams_per_band=2,
+        )
+        server._tasks.append(asyncio.create_task(server._tick_loop()))
+        try:
+            out = await run_storm(
+                addr, "prop", workers=6, duration=1.5, bands=(0, 1),
+                wants=5.0, stream=True, seed=7,
+            )
+            # 3 workers per band against a cap of 2: some establish
+            # (each opening snapshot is a push), the extras shed.
+            assert out["ok"] >= 2, out
+            assert out["pushes"] >= out["ok"], out
+            assert out["shed"] >= 1 and out["shed_by_band"], out
+            assert out["errors"] == 0, out
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_slow_consumer_reset():
+    """A subscription whose queue overflows is terminated with a
+    redirect-to-self (resume beats dropping deltas)."""
+
+    async def body():
+        from doorman_tpu.server.streams import (
+            QUEUE_SIZE,
+            StreamRegistry,
+            Subscription,
+        )
+
+        t = [100.0]
+        server, addr = await make_server(
+            lambda: t[0], native_store=False, stream_push=True
+        )
+        try:
+            registry = server._streams
+            sub = Subscription("c", 0, {"prop": (10.0, 0)})
+            registry._subs.add(sub)
+            for _ in range(QUEUE_SIZE + 4):
+                registry._enqueue(sub, registry._message([]))
+            assert sub.terminated
+            assert registry.total_resets == 1
+            # The last queued message is the terminal redirect.
+            last = None
+            while not sub.queue.empty():
+                last = sub.queue.get_nowait()
+            assert last is not None and last.HasField("mastership")
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_seq_stamped_from_persist_journal():
+    """With persistence configured, pushed seqs ride the journal's
+    sequence numbers: strictly increasing and never below the journal
+    position that recorded the push's decides."""
+
+    async def body():
+        from doorman_tpu.persist import PersistManager
+        from doorman_tpu.persist.backend import MemoryBackend
+
+        t = [2000.0]
+        clock = lambda: t[0]  # noqa: E731
+        server, addr = await make_server(
+            clock, native_store=False, stream_push=True,
+            persist=PersistManager(
+                MemoryBackend(), snapshot_interval=1e9,
+                flush_interval=1.0, clock=clock,
+            ),
+        )
+        ch = grpc.aio.insecure_channel(addr)
+        try:
+            stub = CapacityStub(ch)
+            req = spb.WatchCapacityRequest(client_id="w")
+            rr = req.resource.add()
+            rr.resource_id = "prop"
+            rr.wants = 30.0
+            reader = StreamReader(stub.WatchCapacity(req))
+            msgs = [await reader.read()]
+            # Churn from another client forces pushes.
+            other = {}
+            seqs = [msgs[0].seq]
+            for wants in (90.0, 150.0, 40.0):
+                creq = pb.GetCapacityRequest(client_id="c")
+                crr = creq.resource.add()
+                crr.resource_id = "prop"
+                crr.wants = wants
+                if other.get("prop") is not None:
+                    crr.has.CopyFrom(other["prop"])
+                out = await stub.GetCapacity(creq)
+                lease = pb.Lease()
+                lease.CopyFrom(out.response[0].gets)
+                other["prop"] = lease
+                before = server._streams.total_messages
+                t[0] += 1.0
+                await server.tick_once()
+                t[0] += 1.0
+                await server.tick_once()
+                for msg in await reader.read_exactly(
+                    server._streams.total_messages - before
+                ):
+                    seqs.append(msg.seq)
+            assert len(seqs) >= 3
+            assert all(b > a for a, b in zip(seqs, seqs[1:])), seqs
+            assert seqs[-1] >= server._persist.journal.seq - 2
+            reader.cancel()
+        finally:
+            await ch.close()
+            await server.stop()
+
+    run(body())
+
+
+@pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+def test_delta_filter_limits_fanout_decides():
+    """With the resident delta tracking live and refresh intervals
+    longer than the tick, quiet ticks run ZERO fanout decides and a
+    one-resource churn only re-decides that resource's subscribers —
+    the 1M-subscriber scaling argument, observable at small scale."""
+
+    async def body():
+        t = [3000.0]
+        clock = lambda: t[0]  # noqa: E731
+        config = parse_yaml_config(
+            "resources:\n"
+            "- identifier_glob: \"*\"\n"
+            "  capacity: 100\n"
+            "  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 600,\n"
+            "              refresh_interval: 30,\n"
+            "              learning_mode_duration: 0}\n"
+        )
+        server = CapacityServer(
+            "srv", TrivialElection(), mode="batch", tick_interval=1.0,
+            minimum_refresh_interval=0.0, clock=clock,
+            native_store=True, stream_push=True,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(config)
+        await asyncio.sleep(0)
+        server.current_master = f"127.0.0.1:{port}"
+        for task in server._tasks:
+            task.cancel()
+        server._tasks.clear()
+        ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        try:
+            stub = CapacityStub(ch)
+            readers = []
+            for i, rid in enumerate(("ra", "rb", "rc")):
+                req = spb.WatchCapacityRequest(client_id=f"w{i}")
+                rr = req.resource.add()
+                rr.resource_id = rid
+                rr.wants = 30.0
+                reader = StreamReader(stub.WatchCapacity(req))
+                assert (await reader.read()).snapshot
+                readers.append(reader)
+
+            decides = []
+            orig = server._decide
+            server._decide = lambda rid, request: (
+                decides.append(rid), orig(rid, request)
+            )[1]
+            # Warm ticks: deliveries converge, then quiet ticks decide
+            # nothing (refresh_interval 30 >> tick 1).
+            for _ in range(4):
+                t[0] += 1.0
+                await server.tick_once()
+            decides.clear()
+            for _ in range(3):
+                t[0] += 1.0
+                await server.tick_once()
+            assert decides == [], f"quiet ticks decided: {decides}"
+            # Churn one resource: only its subscriber re-decides.
+            creq = pb.GetCapacityRequest(client_id="x")
+            crr = creq.resource.add()
+            crr.resource_id = "rb"
+            crr.wants = 500.0
+            await stub.GetCapacity(creq)
+            decides.clear()
+            for _ in range(2):
+                t[0] += 1.0
+                await server.tick_once()
+            assert set(decides) == {"rb"}, decides
+            for reader in readers:
+                reader.cancel()
+        finally:
+            await ch.close()
+            await server.stop()
+
+    run(body())
