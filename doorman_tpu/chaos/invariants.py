@@ -108,6 +108,41 @@ class InvariantChecker:
         out += self._check_lag_never_lead(tick, clients)
         return out
 
+    # -- federation -----------------------------------------------------
+
+    def check_federation(
+        self,
+        tick: int,
+        shard_servers: Dict[str, object],
+        straddle: Dict[str, float],
+    ) -> List[Violation]:
+        """The capacity-sum invariant of the federated tree: for every
+        straddling resource, the grants outstanding across ALL shards
+        sum to at most the configured capacity — on every tick,
+        partition or not. No lease-window slack here: the reconciler's
+        contract is that shares sum under capacity and a lost shard's
+        share stays charged through its drain window, so the bound
+        holds pointwise (doc/federation.md, "The invariant")."""
+        out: List[Violation] = []
+        for rid, capacity in straddle.items():
+            total = 0.0
+            holders = []
+            for name, server in sorted(shard_servers.items()):
+                res = server.resources.get(rid)
+                if res is None:
+                    continue
+                res.store.clean()
+                if res.store.sum_has:
+                    holders.append(f"{name}={res.store.sum_has:.6f}")
+                total += res.store.sum_has
+            if total > capacity + EPS:
+                out.append(Violation(
+                    tick, "fed_capacity_sum", rid,
+                    f"Σ shard grants {total:.6f} > configured "
+                    f"capacity {capacity:.6f} ({', '.join(holders)})",
+                ))
+        return out
+
     # -- admission ------------------------------------------------------
 
     def _check_admission(self, tick, servers) -> List[Violation]:

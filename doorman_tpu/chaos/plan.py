@@ -50,6 +50,11 @@ KINDS = frozenset(
         # while active; params: {"clients": n, "wants": w,
         # "priority": band}. Storm clients release on heal.
         "client_storm",
+        # federation seam (driven by the runner's federated beat):
+        # target shard server (e.g. "s1") is unreachable from the
+        # straddle reconciler while active — its share stops renewing,
+        # coasts to its ttl, then the shard decays to zero capacity.
+        "shard_partition",
     }
 )
 

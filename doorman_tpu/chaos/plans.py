@@ -243,6 +243,55 @@ def client_storm() -> FaultPlan:
     )
 
 
+def shard_partition() -> FaultPlan:
+    """Federated root tier: three shards (per-shard election locks, one
+    master each), one straddling resource r0 (capacity 90) whose shares
+    reconcile POP-style every tick, one client per shard (wants
+    30/30/60: overloaded, so shares sit at the demand-proportional
+    22.5/22.5/45). At the fault tick, shard s1 partitions from the
+    reconciler: its share stops renewing, coasts to its ttl, then the
+    shard decays to ZERO capacity — its client degrades (the plan's
+    `degraded` marker). Blast radius is the invariant: the other
+    shards' clients ride through byte-unchanged (shard_blast_radius),
+    and Σ shard grants never exceeds 90 on any tick, because the lost
+    shard's frozen share stays charged against the pool through its
+    drain window (fed_capacity_sum — POP's reconciliation safety).
+    At heal the reconciler reaches s1 again, re-grants its share, and
+    the allocation reconverges to baseline within budget."""
+    return FaultPlan(
+        name="shard_partition",
+        seed=7,
+        setup={
+            "servers": 3,
+            "federated": {
+                "shards": 3,
+                "straddle": ["r0"],
+                "share_ttl": 2.0,
+                "client_shards": [0, 1, 2],
+            },
+            "clients": 3,
+            "wants": [30.0, 30.0, 60.0],
+            "capacity": 90,
+            # Batch mode re-solves every store row each tick, so a
+            # share shrink lands on ALL of a shard's grants the very
+            # next tick — the pointwise capacity-sum bound needs no
+            # refresh-ordering slack.
+            "mode": "batch",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="shard_partition", target="s1",
+                       duration_ticks=6),
+        ],
+        warmup_ticks=8,
+        total_ticks=26,
+        reconverge_ticks=6,
+    )
+
+
 PLANS: Dict[str, "callable"] = {
     "master_flap": master_flap,
     "master_flap_warm": master_flap_warm,
@@ -250,6 +299,7 @@ PLANS: Dict[str, "callable"] = {
     "etcd_brownout": etcd_brownout,
     "device_tunnel_outage": device_tunnel_outage,
     "intermediate_partition": intermediate_partition,
+    "shard_partition": shard_partition,
 }
 
 

@@ -43,7 +43,12 @@ from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import slo as slo_mod
 from doorman_tpu.obs.flightrec import FlightRecorder, store_digest
 from doorman_tpu.server.config import parse_yaml_config
-from doorman_tpu.server.election import Election, InMemoryKV, TrivialElection
+from doorman_tpu.server.election import (
+    Election,
+    InMemoryKV,
+    TrivialElection,
+    shard_lock_key,
+)
 from doorman_tpu.server.server import CapacityServer
 
 LOCK = "/chaos/master"
@@ -164,6 +169,18 @@ class ChaosRunner:
         # shared filesystem / etcd prefix a real warm-takeover
         # deployment needs.
         self.persist_backend = None
+        # Federated topology (setup["federated"]): each server is a
+        # root shard with its OWN election lock (shard_lock_key) and
+        # its own persist namespace; the coordinator runs the straddle
+        # reconciliation beat in the stepped schedule, and the
+        # shard_partition fault kind blocks one shard from it.
+        self.federation = None  # Optional[federation.FederatedRoots]
+        self._shard_backends: Dict[int, object] = {}
+        # Blast-radius guard: healthy clients' capacities snapshotted
+        # at partition start; a healthy client dropping below it while
+        # the fault is active is a shard_blast_radius violation.
+        self._fed_guard: Optional[Dict[str, float]] = None
+        self._fed_last_shares: Dict[str, dict] = {}
         self._logged_restores: set = set()
         self.log: List[list] = []
         self.violations: List[Violation] = []
@@ -225,21 +242,34 @@ class ChaosRunner:
             from doorman_tpu.persist.backend import MemoryBackend
 
             self.persist_backend = MemoryBackend()
+        fed = s.get("federated")
         for i in range(int(s.get("servers", 1))):
             name = f"s{i}"
             proxy = ChaosGrpcProxy(self.state, link=f"link:{name}")
             await proxy.start()
+            # Federated: each server IS a shard and campaigns for its
+            # own shard-suffixed lock — N concurrent masters by design.
+            lock = shard_lock_key(LOCK, i) if fed else LOCK
             election = SteppedElection(
                 ChaosLeaseKV(self.kv, self.state, name),
-                LOCK, ttl=float(s.get("election_ttl", 3.0)),
+                lock, ttl=float(s.get("election_ttl", 3.0)),
                 clock=self.clock,
             )
             persist = None
-            if self.persist_backend is not None:
+            backend = self.persist_backend
+            if backend is not None and fed:
+                # Per-shard durability namespace: candidates of one
+                # shard share a backend; shards never share.
+                from doorman_tpu.persist.backend import MemoryBackend
+
+                backend = self._shard_backends.setdefault(
+                    i, MemoryBackend()
+                )
+            if backend is not None:
                 from doorman_tpu.persist import PersistManager
 
                 persist = PersistManager(
-                    self.persist_backend,
+                    backend,
                     snapshot_interval=float(
                         s.get("snapshot_interval", 3.0)
                     ),
@@ -272,6 +302,7 @@ class ChaosRunner:
                 # Streaming leg: every candidate serves WatchCapacity
                 # (the runner drives the fanout beat explicitly).
                 stream_push=bool(s.get("streams")),
+                shard=i if fed else None,
             )
             SolverInjector(self.state, name).install(server)
             await server.start(0, host="127.0.0.1")
@@ -281,6 +312,24 @@ class ChaosRunner:
             self.servers[name] = server
             self.proxies[name] = proxy
             self.elections[name] = election
+
+        if fed:
+            from doorman_tpu.federation import FederatedRoots, ShardRouter
+
+            router = ShardRouter(
+                int(s.get("servers", 1)),
+                straddle=fed.get("straddle", ()),
+                overrides=fed.get("overrides"),
+            )
+            self.federation = FederatedRoots(
+                router,
+                {
+                    i: self.servers[f"s{i}"]
+                    for i in range(router.n_shards)
+                },
+                share_ttl=float(fed.get("share_ttl", 2.0)),
+                clock=self.clock,
+            )
 
         attach = self.proxies["s0"].address
         if s.get("intermediate"):
@@ -315,11 +364,25 @@ class ChaosRunner:
             10.0 * (i + 1) for i in range(int(s.get("clients", 3)))
         ]
         priorities = s.get("priorities") or [0] * len(wants)
+        # Federated: clients place onto shards per the plan (the
+        # straddling resource is served by EVERY shard; which one a
+        # client talks to is its locality).
+        client_shards = (fed or {}).get("client_shards") or [None] * len(
+            wants
+        )
         self._attach = attach
+        self._client_shard: Dict[str, Optional[int]] = {}
         for i, (w, p) in enumerate(zip(wants, priorities)):
+            addr = attach
+            shard = client_shards[i]
+            if shard is not None:
+                addr = self.proxies[f"s{int(shard)}"].address
             client = Client(
-                attach, f"c{i}", minimum_refresh_interval=0.0,
+                addr, f"c{i}", minimum_refresh_interval=0.0,
                 max_retries=0, clock=self.clock,
+            )
+            self._client_shard[client.id] = (
+                int(shard) if shard is not None else None
             )
             await client.resource(RESOURCE, float(w), priority=int(p))
             self.clients.append(client)
@@ -442,6 +505,60 @@ class ChaosRunner:
                     out["pushes"],
                 ])
 
+    def _drive_federation(self, tick: int) -> None:
+        """The federated beat: translate active shard_partition faults
+        into the coordinator's blocked set, run one reconciliation, and
+        log share movements deterministically. Also arms/checks the
+        blast-radius guard: while a partition is active, no client of a
+        HEALTHY shard may fall below its pre-fault capacity — the whole
+        point of per-shard mastership is that one shard's failure is
+        one shard's outage."""
+        if self.federation is None:
+            return
+        blocked = {
+            shard
+            for shard in range(self.federation.router.n_shards)
+            if self.state.active("shard_partition", f"s{shard}")
+            is not None
+        }
+        if blocked and not self.federation.blocked:
+            # Partition begins: snapshot the healthy population.
+            self._fed_guard = {
+                key: value
+                for key, value in self._snapshot().items()
+                if self._client_shard.get(key.split("/", 1)[0])
+                not in blocked
+            }
+        elif not blocked:
+            self._fed_guard = None
+        self.federation.blocked = blocked
+        installed = self.federation.reconcile_once()
+        for rid, shares in sorted(installed.items()):
+            rounded = [
+                [shard, round(value, 6)]
+                for shard, value in sorted(shares.items())
+            ]
+            if self._fed_last_shares.get(rid) != rounded:
+                self._fed_last_shares[rid] = rounded
+                self.log.append([tick, "straddle", rid, rounded])
+
+    def _check_blast_radius(self, tick: int) -> List[Violation]:
+        """Healthy-shard clients must ride through a sibling shard's
+        partition untouched (checked AFTER this tick's refreshes, like
+        every other invariant)."""
+        if self._fed_guard is None:
+            return []
+        out = []
+        for key, value in self._snapshot().items():
+            baseline = self._fed_guard.get(key)
+            if baseline is not None and value < baseline - 1e-9:
+                out.append(Violation(
+                    tick, "shard_blast_radius", key,
+                    f"healthy-shard client fell {baseline:.6f} -> "
+                    f"{value:.6f} during a sibling shard's partition",
+                ))
+        return out
+
     def _log_admission(self, tick: int) -> None:
         """One deterministic event-log entry per server per tick where
         admission activity moved: GetCapacity admitted/shed deltas plus
@@ -517,6 +634,18 @@ class ChaosRunner:
             rec["admission"] = admission
         if streams:
             rec["streams"] = streams
+        if self.federation is not None:
+            # The federation beat on the black box: each shard's
+            # installed straddle capacity (deterministic plan
+            # arithmetic) — a partition reads as one shard's value
+            # freezing and then vanishing while the others hold.
+            rec["straddle_capacity"] = {
+                name: round(
+                    server.fed_stats["straddle_capacity"], 6
+                )
+                for name, server in sorted(self.servers.items())
+                if getattr(server, "shard", None) is not None
+            }
         if persist_seq:
             rec["persist_seq"] = persist_seq
         if violations:
@@ -598,7 +727,14 @@ class ChaosRunner:
                 self.clock,
                 lease_length=float(plan.setup.get("lease_length", 60)),
             )
-            groups = [[n for n in self.servers if n.startswith("s")]]
+            if self.federation is not None:
+                # Per-shard mastership: each shard campaigns for its
+                # own lock, so each is its own single-master group.
+                groups = [
+                    [n] for n in self.servers if n.startswith("s")
+                ]
+            else:
+                groups = [[n for n in self.servers if n.startswith("s")]]
             heal_tick = plan.heal_tick
             baseline: Optional[Dict[str, float]] = None
             converged_at: Optional[int] = None
@@ -623,6 +759,8 @@ class ChaosRunner:
                 if masters != last_masters:
                     last_masters = masters
                     self.log.append([tick, "master", list(masters)])
+
+                self._drive_federation(tick)
 
                 if inter is not None:
                     await inter._perform_parent_requests(0)
@@ -664,6 +802,11 @@ class ChaosRunner:
                     self.clients + self.stream_clients
                     + self.storm_clients,
                 )
+                if self.federation is not None:
+                    tick_violations = tick_violations + checker.check_federation(
+                        tick, self.servers,
+                        self.federation.straddle_capacities(),
+                    ) + self._check_blast_radius(tick)
                 for v in tick_violations:
                     self._record_violation(v)
                     self.log.append([tick] + v.as_log())

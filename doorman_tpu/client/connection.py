@@ -73,6 +73,13 @@ class Connection:
             )
         self._channel: Optional[grpc.aio.Channel] = None
         self.stub: Optional[CapacityStub] = None
+        # Mastership-redirect observer: called with the new master's
+        # address every time this connection follows a redirect. The
+        # federated discovery cache hooks it (invalidate-on-redirect:
+        # a flip observed on a live connection updates the cache at RPC
+        # speed instead of triggering a Discovery round). Observer
+        # errors never break the chase.
+        self.on_redirect: Optional[Callable[[str], None]] = None
 
     def __str__(self) -> str:
         return self.current_master
@@ -110,6 +117,15 @@ class Connection:
         execute()'s mastership chase (a terminal WatchCapacityResponse
         carries the address instead of a unary mastership field)."""
         await self._connect(addr)
+        self._note_redirect(addr)
+
+    def _note_redirect(self, addr: str) -> None:
+        if self.on_redirect is None:
+            return
+        try:
+            self.on_redirect(addr)
+        except Exception:
+            log.exception("on_redirect observer failed")
 
     async def execute(
         self, call: Callable[[CapacityStub], Awaitable[T]]
@@ -171,6 +187,7 @@ class Connection:
                     last_error = MasterUnknown(mastership.master_address)
                     break
                 await self._connect(mastership.master_address)
+                self._note_redirect(mastership.master_address)
 
         raise last_error if last_error is not None else MasterUnknown(self.addr)
 

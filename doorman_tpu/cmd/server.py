@@ -27,7 +27,12 @@ from doorman_tpu.obs import (
 )
 from doorman_tpu.server import config as config_mod
 from doorman_tpu.server import sources
-from doorman_tpu.server.election import EtcdKV, KVElection, TrivialElection
+from doorman_tpu.server.election import (
+    EtcdKV,
+    KVElection,
+    TrivialElection,
+    shard_lock_key,
+)
 from doorman_tpu.server.server import CapacityServer
 from doorman_tpu.utils import flagenv
 
@@ -156,6 +161,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "past it sheds with RESOURCE_EXHAUSTED + "
                         "retry-after so fanout cannot starve the "
                         "tick; 0 = unlimited")
+    p.add_argument("--shard", default="",
+                   help="federated root shard identity as 'i/N' (shard "
+                        "i of N): suffixes the election lock with "
+                        "/shard<i> (per-shard mastership), namespaces "
+                        "--persist under shard<i> (per-shard "
+                        "journal/snapshot, warm takeover stays "
+                        "per-shard), and stamps the shard index on "
+                        "status pages and flight-recorder records. "
+                        "Every candidate of one shard passes the SAME "
+                        "value; clients route with the same N "
+                        "(doc/federation.md)")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -186,10 +202,32 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
     etcd_endpoints = [
         e.strip() for e in args.etcd_endpoints.split(",") if e.strip()
     ]
+    shard = None
+    if args.shard:
+        # 'i/N': i is this server's shard, N the deployment's shard
+        # count (kept for validation + status; routing uses the same N
+        # client-side through federation.ShardRouter).
+        try:
+            shard_str, _, count_str = args.shard.partition("/")
+            shard, shard_count = int(shard_str), int(count_str)
+            if not 0 <= shard < shard_count:
+                raise ValueError
+        except ValueError:
+            log.error("--shard wants 'i/N' with 0 <= i < N, got %r",
+                      args.shard)
+            raise SystemExit(2)
+        log.info("federated root shard %d of %d", shard, shard_count)
     if args.master_election_lock:
+        lock = args.master_election_lock
+        if shard is not None:
+            # Per-shard mastership: shard k's candidates campaign for
+            # <lock>/shard<k> — N concurrent masters off one etcd
+            # namespace, and one shard's failover never disturbs the
+            # others (election.shard_lock_key).
+            lock = shard_lock_key(lock, shard)
         election = KVElection(
             EtcdKV(etcd_endpoints),
-            args.master_election_lock,
+            lock,
             ttl=args.master_delay,
         )
     else:
@@ -200,7 +238,13 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         from doorman_tpu.persist import PersistManager, parse_backend
 
         persist = PersistManager(
-            parse_backend(args.persist, etcd_endpoints=etcd_endpoints),
+            parse_backend(
+                args.persist,
+                etcd_endpoints=etcd_endpoints,
+                # Per-shard durability namespace: warm takeover restores
+                # exactly this shard's slice, never a sibling's.
+                namespace=f"shard{shard}" if shard is not None else "",
+            ),
             snapshot_interval=args.snapshot_interval,
             flush_interval=min(args.tick_interval, 1.0),
         )
@@ -269,6 +313,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         tick_pipeline_depth=args.tick_pipeline_depth,
         stream_push=args.stream_push,
         max_streams_per_band=args.max_streams_per_band,
+        shard=shard,
     )
 
     port = await server.start(

@@ -1,0 +1,49 @@
+"""doorman_tpu.federation — POP-sharded multi-master roots.
+
+The step from "one master, 1M clients" to "a region, tens of millions":
+the resource space partitions across N root shards (router.py), each an
+ordinary CapacityServer with its own per-shard mastership
+(election.shard_lock_key) and persist namespace
+(persist.parse_backend(namespace=...)); clients and intermediates fan
+their batches out to the owning shards through a jittered-TTL master
+cache (discovery.py, client.py); intermediates aggregate their subtree
+on device (aggregate.py); and resources whose capacity straddles shards
+reconcile POP-style each tick (reconcile.py, roots.py) with the hard
+invariant that the sum of shard grants never exceeds the configured
+capacity and convergence to the single-root allocation. doc/federation.md
+is the design note; tests/test_federation.py is the conformance suite.
+"""
+
+from doorman_tpu.federation.aggregate import (  # noqa: F401
+    AggregationTickAdapter,
+    FederatedIntermediate,
+)
+from doorman_tpu.federation.client import FederatedClient  # noqa: F401
+from doorman_tpu.federation.discovery import (  # noqa: F401
+    ShardDiscovery,
+    ShardResolveError,
+)
+from doorman_tpu.federation.reconcile import (  # noqa: F401
+    ShardSummary,
+    StraddleReconciler,
+    summarize_resource,
+)
+from doorman_tpu.federation.roots import FederatedRoots  # noqa: F401
+from doorman_tpu.federation.router import (  # noqa: F401
+    ShardRouter,
+    stable_shard,
+)
+
+__all__ = [
+    "AggregationTickAdapter",
+    "FederatedClient",
+    "FederatedIntermediate",
+    "FederatedRoots",
+    "ShardDiscovery",
+    "ShardResolveError",
+    "ShardRouter",
+    "ShardSummary",
+    "StraddleReconciler",
+    "stable_shard",
+    "summarize_resource",
+]

@@ -237,7 +237,12 @@ class FlightRecorder:
                     "pid": pid, "tid": 0, "ts": ts, "s": "t",
                     "args": args,
                 })
-            for counter in ("admission_level", "persist_seq"):
+            # straddle_capacity / straddle_updates / upstream_rpcs are
+            # the federation beat (server records stamp them per tick
+            # when the server is a shard — doc/federation.md).
+            for counter in ("admission_level", "persist_seq",
+                            "straddle_capacity", "straddle_updates",
+                            "upstream_rpcs"):
                 v = rec.get(counter)
                 if isinstance(v, (int, float)):
                     events.append({

@@ -86,10 +86,17 @@ KNOWN_SPAN_NAMES = frozenset({
     "persist.snapshot",
     "persist.restore",
     "stream.fanout",  # tick-edge lease push (server/streams.py)
+    # Federated capacity tree (doorman_tpu/federation): the straddle
+    # reconciliation beat and the intermediate's device aggregation
+    # tick; federation.* admits computed suffixes.
+    "federation.reconcile",
+    "federation.aggregate",
+    "federation.*",
 })
 KNOWN_INSTANT_NAMES = frozenset({
     "election.transition",
     "shard.*",  # per-direction mesh transfer instants: shard.upload, ...
+    "federation.*",  # e.g. federation.partition from the chaos seam
 })
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must

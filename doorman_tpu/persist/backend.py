@@ -281,13 +281,35 @@ class EtcdBackend(PersistBackend):
             self.append_journal(records)
 
 
-def parse_backend(spec: str, *, etcd_endpoints: Sequence[str] = ()) -> PersistBackend:
+def parse_backend(
+    spec: str,
+    *,
+    etcd_endpoints: Sequence[str] = (),
+    namespace: str = "",
+) -> PersistBackend:
     """Build a backend from a `--persist` flag value:
-    `file:<directory>` or `etcd:<key-prefix>` (needs --etcd-endpoints)."""
+    `file:<directory>` or `etcd:<key-prefix>` (needs --etcd-endpoints).
+
+    `namespace` scopes the snapshot slot and journal under a
+    sub-directory / key sub-prefix — the per-shard durability
+    namespaces of a federated deployment (every root shard persists
+    and warm-restores its own slice; candidates of the SAME shard
+    share the namespace, different shards never touch each other's).
+    Namespaces must be path/key-safe tokens; the federated flag
+    surface passes `shard<N>`."""
     scheme, sep, rest = spec.partition(":")
     if not sep or not rest:
         raise ValueError(
             f"--persist wants file:<dir> or etcd:<prefix>, got {spec!r}"
+        )
+    if namespace:
+        if "/" in namespace or namespace in (".", ".."):
+            raise ValueError(
+                f"persist namespace must be a single path token, "
+                f"got {namespace!r}"
+            )
+        rest = os.path.join(rest, namespace) if scheme == "file" else (
+            rest.rstrip("/") + "/" + namespace
         )
     if scheme == "file":
         return FileBackend(rest)

@@ -31,6 +31,18 @@ IsMasterCallback = Callable[[bool], Awaitable[None]]
 CurrentMasterCallback = Callable[[str], Awaitable[None]]
 
 
+def shard_lock_key(lock: str, shard: int) -> str:
+    """The per-shard election lease key of a federated deployment:
+    shard k's candidates campaign for `<lock>/shard<k>` instead of the
+    single root lock, so each shard runs its OWN mastership (N
+    concurrent masters, one per shard, off one etcd namespace) and a
+    shard's failover never disturbs the others. Shard -1 (or any
+    negative) means "not federated" and returns the lock unchanged."""
+    if shard < 0:
+        return lock
+    return f"{lock.rstrip('/')}/shard{int(shard)}"
+
+
 class Election(abc.ABC):
     """A master election. `run` starts campaigning and returns immediately;
     outcomes are delivered through the callbacks (mirrors the reference's

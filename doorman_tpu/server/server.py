@@ -111,10 +111,32 @@ class CapacityServer(CapacityServicer):
         tick_pipeline_depth: int = 1,
         stream_push: bool = False,
         max_streams_per_band: int = 0,
+        shard: Optional[int] = None,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
         self.id = server_id
+        # Federation identity: which root shard this server is (None =
+        # unsharded deployment). The shard index rides status(), the
+        # stream registry's status, and every flight-recorder tick
+        # record, so a federated fleet's dumps and debug pages say
+        # which slice of the resource space they describe. The shard's
+        # election lock and persist namespace are the CALLER's job
+        # (election.shard_lock_key / persist.parse_backend(namespace=));
+        # this field is identity, not enforcement.
+        self.shard = shard
+        # Federation counters (the reconciler and the federated
+        # intermediate's upstream exchange bump these): straddle-share
+        # installs, the capacity those shares currently sum to, and
+        # upstream RPCs issued. Plain dict so harness code can extend.
+        self.fed_stats: Dict[str, float] = {
+            "straddle_updates": 0,
+            "straddle_capacity": 0.0,
+            "upstream_rpcs": 0,
+        }
+        # resource id -> this shard's currently installed share (feeds
+        # fed_stats["straddle_capacity"] as the sum over resources).
+        self._straddle_shares: Dict[str, float] = {}
         self.election = election
         self.mode = mode
         self.tick_interval = tick_interval
@@ -261,6 +283,7 @@ class CapacityServer(CapacityServicer):
         else:
             self.flightrec = None
         self._flight_phase_prev: Dict[str, float] = {}
+        self._flight_fed_prev: Dict[str, float] = {}
         # Last SLO evaluation (evaluate_slos); status() and /debug/slo
         # read it. None until the first evaluation.
         self.last_slo: Optional[dict] = None
@@ -447,6 +470,10 @@ class CapacityServer(CapacityServicer):
                 self._persist.note_step_down()
         self.resources = {}
         self._server_bands = {}
+        # Straddle shares die with the lease state: a fresh master (or
+        # standby) holds no share until the reconciler grants one.
+        self._straddle_shares = {}
+        self.fed_stats["straddle_capacity"] = 0.0
         self._reset_store_engine()
         # The engine was replaced: the resident solvers' device tables
         # and any in-flight ticks refer to the old one.
@@ -917,6 +944,30 @@ class CapacityServer(CapacityServicer):
         self._profiling = False
         self._profile_done = True
 
+    def set_straddle_share(
+        self, resource_id: str, capacity: float, expiry: float
+    ) -> None:
+        """Federation hook: install this shard's reconciled share of a
+        straddling resource's capacity as a parent-style lease — the
+        local template's capacity becomes the share and `expiry` rides
+        as the parent-lease expiry, so a shard the reconciler stops
+        renewing decays to zero capacity on its own (the partition
+        blast-radius story, doc/federation.md). Template-only: no store
+        row moves here, so the fused-staging pack cache stays valid;
+        the config epoch bump routes the new capacity through the
+        resident solver's config mirror like any reload."""
+        res = self.get_or_create_resource(resource_id)
+        tpl = pb.ResourceTemplate()
+        tpl.CopyFrom(res.template)
+        tpl.capacity = float(capacity)
+        res.load_config(tpl, float(expiry))
+        self._config_epoch += 1
+        self.fed_stats["straddle_updates"] += 1
+        self._straddle_shares[resource_id] = float(capacity)
+        self.fed_stats["straddle_capacity"] = float(
+            sum(self._straddle_shares.values())
+        )
+
     def persist_step(self) -> None:
         """One durability beat (journal flush + cadenced snapshot +
         compaction) when persistence is configured and this server is
@@ -1041,6 +1092,24 @@ class CapacityServer(CapacityServicer):
             "resources": len(self.resources),
             "digest": store_digest(self.resources),
         }
+        if self.shard is not None:
+            # Federation beat on the black box: which shard this is,
+            # how much straddle traffic moved since the last tick
+            # (share installs + upstream RPCs), and the capacity the
+            # installed shares currently sum to — the overlay counters
+            # for "the reconciler is eating the tick" triage.
+            rec["shard"] = self.shard
+            for key in ("straddle_updates", "upstream_rpcs"):
+                delta = self.fed_stats[key] - self._flight_fed_prev.get(
+                    key, 0
+                )
+                if delta:
+                    rec[key] = int(delta)
+            self._flight_fed_prev = dict(self.fed_stats)
+            if self.fed_stats["straddle_capacity"]:
+                rec["straddle_capacity"] = round(
+                    self.fed_stats["straddle_capacity"], 6
+                )
         if phases:
             rec["phases"] = phases
         if self._resident is not None:
@@ -1750,6 +1819,18 @@ class CapacityServer(CapacityServicer):
             "streams": (
                 self._streams.status()
                 if self._streams is not None
+                else None
+            ),
+            # Federation identity + traffic (None: unsharded server
+            # with no federated activity).
+            "federation": (
+                {
+                    "shard": self.shard,
+                    "straddle_shares": dict(self._straddle_shares),
+                    **{k: v for k, v in self.fed_stats.items()},
+                }
+                if self.shard is not None
+                or any(self.fed_stats.values())
                 else None
             ),
             "last_restore": self.last_restore,

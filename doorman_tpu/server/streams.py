@@ -366,6 +366,12 @@ class StreamRegistry:
 
     def status(self) -> dict:
         return {
+            # Federated deployments run one registry per root shard;
+            # seqs (and therefore resume tokens) are scoped to this
+            # shard's persist journal — a resume token from shard A is
+            # meaningless on shard B, which is why the shard index
+            # rides the status block (doc/federation.md).
+            "shard": getattr(self._server, "shard", None),
             "subscribers": len(self._subs),
             "by_band": {
                 str(b): n for b, n in sorted(self._band_counts.items())

@@ -70,10 +70,13 @@ __all__ = [
 # pipeline stage is triaged like the others. "delta" is the host tail of
 # delivered-grant delta extraction (streaming lease push): resolving the
 # device-compared changed-row mask to engine rids — the mask itself
-# lands with the delivery download.
+# lands with the delivery download. "aggregate" is the federated
+# intermediate's band-masked subtree summation (the launch half of its
+# device tick, federation/aggregate.py) — its own name because it is a
+# different executable than "solve", not a lease solve at all.
 PHASES = (
     "sweep", "drain", "config", "pack", "staging", "upload", "solve",
-    "download", "apply", "delta", "rebuild",
+    "aggregate", "download", "apply", "delta", "rebuild",
 )
 
 

@@ -43,6 +43,10 @@ CHAOS_REACHABLE = (
     "doorman_tpu/core/",
     "doorman_tpu/ratelimiter/",
     "doorman_tpu/utils/",
+    # The federated tree runs under the chaos runner (shard_partition):
+    # its reconcile beat, discovery jitter, and client fan-out must all
+    # draw time/randomness through the injectable seams.
+    "doorman_tpu/federation/",
 )
 
 _TIME_CALLS = {"time.time", "time.monotonic"}
