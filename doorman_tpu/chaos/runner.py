@@ -41,6 +41,7 @@ from doorman_tpu.client.client import Client
 from doorman_tpu.client.connection import Connection
 from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import slo as slo_mod
+from doorman_tpu.obs import trace as trace_mod
 from doorman_tpu.obs.flightrec import FlightRecorder, store_digest
 from doorman_tpu.server.config import parse_yaml_config
 from doorman_tpu.server.election import (
@@ -522,7 +523,13 @@ class ChaosRunner:
             is not None
         }
         if blocked and not self.federation.blocked:
-            # Partition begins: snapshot the healthy population.
+            # Partition begins: mark the timeline (the trace ring is
+            # outside the verdict digests, so replays stay byte-stable)
+            # and snapshot the healthy population.
+            trace_mod.default_tracer().instant(
+                "federation.partition", cat="chaos",
+                args={"tick": tick, "shards": sorted(blocked)},
+            )
             self._fed_guard = {
                 key: value
                 for key, value in self._snapshot().items()

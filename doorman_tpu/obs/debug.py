@@ -54,6 +54,7 @@ ROUTES = (
 
 _parts_lock = threading.Lock()
 _parts: Dict[str, Callable[[], str]] = {}  # guarded-by: _parts_lock
+# doorman: allow[seeded-determinism] process uptime is wall-clock by design
 _start_time = time.time()
 
 
@@ -189,6 +190,7 @@ class DebugServer:
                 f"{rows}</table>"
                 f"<h3>config</h3><pre>{html.escape(st['config'])}</pre>"
             )
+        # doorman: allow[seeded-determinism] uptime display, wall-clock by design
         uptime = time.time() - _start_time
         body = (
             f"<p>uptime: {uptime:.0f}s</p>"
@@ -540,6 +542,7 @@ class DebugServer:
         doorman_server.go:43-45)."""
         return json.dumps(
             {
+                # doorman: allow[seeded-determinism] wall-clock uptime
                 "uptime_seconds": time.time() - _start_time,
                 "servers": self._statuses(),
             },

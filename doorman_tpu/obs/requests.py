@@ -13,7 +13,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Sequence
+from typing import Callable, Deque, List, Sequence
 
 
 @dataclass(frozen=True)
@@ -29,9 +29,13 @@ class RequestSample:
 
 @dataclass
 class RequestLog:
-    """Fixed-size ring of recent requests; thread-safe."""
+    """Fixed-size ring of recent requests; thread-safe.
+
+    `clock` is the injectable time seam (the server hands in its own,
+    so chaos-driven servers stamp samples in virtual time)."""
 
     capacity: int = 256
+    clock: Callable[[], float] = time.time
     _entries: Deque[RequestSample] = field(init=False)
     _lock: threading.Lock = field(init=False)
 
@@ -50,7 +54,7 @@ class RequestLog:
         when: float | None = None,
     ) -> None:
         sample = RequestSample(
-            when=time.time() if when is None else when,
+            when=self.clock() if when is None else when,
             method=method,
             caller=caller,
             resources=tuple(resources),

@@ -293,7 +293,7 @@ class CapacityServer(CapacityServicer):
         # Always-on request sampling for /debug/requests.
         from doorman_tpu.obs.requests import RequestLog
 
-        self.request_log = RequestLog()
+        self.request_log = RequestLog(clock=self._clock)
         # JAX profiler capture of the first batch ticks (SURVEY §5: "add
         # JAX profiler traces around the solve"); view with xprof or
         # tensorboard.

@@ -519,6 +519,10 @@ class BatchSolver:
                 self._solve(snap.edges, snap.resources)
             )
         if part is not None:
+            # The batch solver is the synchronous reference path: its
+            # solve lap deliberately includes the downloads (there is
+            # no pipelining seam to hand the transfer off to).
+            # doorman: allow[device-sync-taint] synchronous path by design
             part.gets = chunked_device_get(prio_gets)
         return gets
 

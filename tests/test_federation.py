@@ -743,6 +743,29 @@ def test_federated_client_fans_refreshes_to_owning_shards():
 # ----------------------------------------------------------------------
 
 
+def test_shard_partition_emits_federation_partition_instant():
+    # The chaos seam marks partition onset on the trace timeline with
+    # the registered `federation.partition` instant (obs/trace.py
+    # KNOWN_INSTANT_NAMES; doormanlint registry-coherence pins that the
+    # registry entry has a live emitter).
+    from doorman_tpu.chaos import ChaosRunner, get_plan
+    from doorman_tpu.obs import trace as trace_mod
+
+    tracer = trace_mod.default_tracer()
+    tracer.enable()
+    try:
+        verdict = asyncio.run(ChaosRunner(get_plan("shard_partition")).run())
+        assert verdict["ok"]
+        marks = [
+            e for e in tracer.snapshot() if e.name == "federation.partition"
+        ]
+        assert marks, "partition onset never hit the trace timeline"
+        assert marks[0].args["shards"] == [1]  # the plan partitions s1
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
 def test_shard_partition_plan_arc_and_determinism():
     from doorman_tpu.chaos import ChaosRunner, get_plan
 

@@ -299,15 +299,21 @@ def make(seed):
 
 
 def test_determinism_unseeded_bare_random_flagged(tree):
+    # Scope is import-derived: ctl.py is covered because the chaos
+    # package (a derivation root) imports it, not because "admission/"
+    # appears in a hand-kept prefix list.
     tree.write("doorman_tpu/admission/ctl.py", """
 import random
 
 RNG = random.Random()
 """)
+    tree.write("doorman_tpu/chaos/drive.py",
+               "from doorman_tpu.admission import ctl\n")
     assert len(tree.active(rules=["seeded-determinism"])) == 1
 
 
 def test_determinism_out_of_scope_module_ignored(tree):
+    # Nothing chaos-reachable imports loadtest: exempt by construction.
     tree.write("doorman_tpu/loadtest/gen.py", """
 import time
 
@@ -623,6 +629,7 @@ def test_cli_list_rules(capsys):
         "jit-closure-capture", "host-sync-in-hot-path",
         "fused-writer-discipline", "seeded-determinism",
         "lock-discipline", "trace-phase-hygiene",
+        "lock-order", "device-sync-taint", "registry-coherence",
     ):
         assert rule in out
 

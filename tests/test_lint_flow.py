@@ -1,0 +1,663 @@
+"""doormanlint v2 (whole-program flow analysis): the graph substrate,
+the three inter-procedural rules, the import-derived determinism scope,
+and the operational gates.
+
+Fixture style matches tests/test_lint.py: tiny source trees under
+tmp_path with the repo-relative layout the checkers scope on. Every new
+rule ships a known-bad fixture that produces EXACTLY the expected
+finding and a known-good twin that stays clean (the acceptance
+criterion), plus the real-repo assertions: federation/ is DERIVED as
+chaos-reachable (the PR-10 near-miss this framework exists to close),
+the full nine-rule suite runs clean, and the whole run stays inside
+the wall-clock budget without ever importing jax.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.lint.core import RepoContext, load_files, run_lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+class Tree:
+    def __init__(self, root: Path):
+        self.root = root
+
+    def write(self, rel: str, text: str) -> None:
+        p = self.root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+
+    def active(self, rules):
+        return [
+            f for f in run_lint(self.root, rules=rules) if not f.suppressed
+        ]
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    return Tree(tmp_path)
+
+
+# ---------------------------------------------------------------------
+# the graph substrate
+# ---------------------------------------------------------------------
+
+
+def graph_of(tree):
+    contexts, errors = load_files(tree.root)
+    assert errors == []
+    return RepoContext(tree.root, contexts).graph
+
+
+def test_import_graph_includes_package_inits(tree):
+    # Importing a.b executes a/__init__.py: the closure must include it
+    # even though nothing names it directly.
+    tree.write("doorman_tpu/chaos/run.py",
+               "from doorman_tpu.lib.util import now\n")
+    tree.write("doorman_tpu/lib/__init__.py", "")
+    tree.write("doorman_tpu/lib/util.py", "def now():\n    return 0\n")
+    g = graph_of(tree)
+    reach = g.reachable_files(("doorman_tpu/chaos/",))
+    assert "doorman_tpu/lib/util.py" in reach
+    assert "doorman_tpu/lib/__init__.py" in reach
+
+
+def test_relative_imports_resolve(tree):
+    tree.write("doorman_tpu/chaos/__init__.py", "from . import helper\n")
+    tree.write("doorman_tpu/chaos/helper.py", "x = 1\n")
+    g = graph_of(tree)
+    assert "doorman_tpu/chaos/helper.py" in \
+        g.imports["doorman_tpu/chaos/__init__.py"]
+
+
+def test_call_resolution_self_module_and_fallback(tree):
+    tree.write("doorman_tpu/server/a.py", """
+from doorman_tpu.server.b import helper
+
+
+class A:
+    def top(self, other):
+        self.mine()          # self -> same class
+        helper()             # imported symbol
+        other.unique_leaf()  # unique-method fallback
+
+    def mine(self):
+        pass
+""")
+    tree.write("doorman_tpu/server/b.py", """
+def helper():
+    pass
+
+
+class B:
+    def unique_leaf(self):
+        pass
+""")
+    g = graph_of(tree)
+    top = g.function_at("doorman_tpu/server/a.py", "A.top")
+    resolved = {t.qualname for _, targets in top.calls for t in targets}
+    assert resolved == {"A.mine", "helper", "B.unique_leaf"}
+
+
+def test_generic_method_names_stay_unresolved(tree):
+    # `.get()` would weld every dict access to any repo class with a
+    # get method; the fallback must refuse it.
+    tree.write("doorman_tpu/server/a.py", """
+class Cache:
+    def get(self, k):
+        return k
+
+
+def use(d):
+    return d.get(1)
+""")
+    g = graph_of(tree)
+    use = g.function_at("doorman_tpu/server/a.py", "use")
+    assert all(not targets for _, targets in use.calls)
+
+
+# ---------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------
+
+LOCK_A = """
+import threading
+
+
+class ASide:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def push(self):
+        with self._lock:
+            self.b.pull_rows()
+
+    def local_sweep(self):
+        with self._lock:
+            pass
+"""
+
+LOCK_B_CYCLE = """
+import threading
+
+
+class BSide:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def pull_rows(self):
+        with self._lock:
+            pass
+
+    def drain(self):
+        with self._lock:
+            self.a.local_sweep()
+"""
+
+LOCK_B_ORDERED = """
+import threading
+
+
+class BSide:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def pull_rows(self):
+        with self._lock:
+            pass
+
+    def drain(self):
+        self.a.local_sweep()
+        with self._lock:
+            pass
+"""
+
+
+def test_lock_order_two_file_cycle(tree):
+    # The PR-9/10 bug class: each file is locally consistent, the
+    # deadlock only exists across the call graph.
+    tree.write("doorman_tpu/server/a.py", LOCK_A)
+    tree.write("doorman_tpu/server/b.py", LOCK_B_CYCLE)
+    found = tree.active(rules=["lock-order"])
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+    assert "ASide._lock" in found[0].message
+    assert "BSide._lock" in found[0].message
+
+
+def test_lock_order_consistent_order_is_clean(tree):
+    tree.write("doorman_tpu/server/a.py", LOCK_A)
+    tree.write("doorman_tpu/server/b.py", LOCK_B_ORDERED)
+    assert tree.active(rules=["lock-order"]) == []
+
+
+BLOCKING_BAD = """
+import queue
+import threading
+
+
+class Fanout:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.outq = queue.Queue(maxsize=256)
+
+    def publish(self, msg):
+        with self._lock:
+            self._send(msg)
+
+    def _send(self, msg):
+        self.outq.put(msg)
+"""
+
+BLOCKING_GOOD = """
+import queue
+import threading
+
+
+class Fanout:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.outq = queue.Queue(maxsize=256)
+        self.seq = 0
+
+    def publish(self, msg):
+        with self._lock:
+            self.seq += 1
+        self._send(msg)
+
+    def _send(self, msg):
+        self.outq.put(msg)
+"""
+
+
+def test_lock_order_blocking_call_under_lock(tree):
+    # A bounded queue.put two calls deep, reached with the lock held.
+    tree.write("doorman_tpu/server/fanout.py", BLOCKING_BAD)
+    found = tree.active(rules=["lock-order"])
+    assert len(found) == 1
+    assert "queue.put" in found[0].message
+    assert "Fanout._lock" in found[0].message
+
+
+def test_lock_order_narrowed_critical_section_is_clean(tree):
+    tree.write("doorman_tpu/server/fanout.py", BLOCKING_GOOD)
+    assert tree.active(rules=["lock-order"]) == []
+
+
+def test_lock_order_lexical_sleep_under_lock(tree):
+    tree.write("doorman_tpu/server/retry.py", """
+import threading
+import time
+
+
+class Retry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spin(self):
+        with self._lock:
+            time.sleep(0.1)
+""")
+    found = tree.active(rules=["lock-order"])
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message
+
+
+def test_lock_order_holds_lock_annotation_feeds_edges(tree):
+    # The annotated helper's acquisition happens "under" the caller's
+    # lock even though no `with` is visible in either body alone.
+    tree.write("doorman_tpu/server/ann.py", """
+import threading
+
+
+class Ann:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):  # holds-lock: self._a
+        with self._b:
+            pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+""")
+    found = tree.active(rules=["lock-order"])
+    assert len(found) == 1
+    assert "cycle" in found[0].message
+
+
+# ---------------------------------------------------------------------
+# device-sync-taint
+# ---------------------------------------------------------------------
+
+TAINT_BAD = """
+import jax.numpy as jnp
+
+
+def _summarize(x):
+    return float(x.sum())
+
+
+class Engine:
+    def dispatch(self, table, ph):
+        gets = jnp.cumsum(table)
+        total = _summarize(gets)
+        ph.lap("solve")
+        ph.lap("download")
+        return total
+"""
+
+TAINT_GOOD = """
+import jax.numpy as jnp
+
+
+def _summarize(x):
+    return float(x.sum())
+
+
+class Engine:
+    def dispatch(self, table, ph):
+        gets = jnp.cumsum(table)
+        ph.lap("solve")
+        ph.lap("download")
+        total = _summarize(gets)
+        ph.lap("apply")
+        return total
+"""
+
+
+def test_taint_sync_reached_through_helper(tree):
+    # The upgrade over host-sync-in-hot-path: float() lives in a
+    # helper, the phase only sees a call.
+    tree.write("doorman_tpu/solver/fast.py", TAINT_BAD)
+    found = tree.active(rules=["device-sync-taint"])
+    assert len(found) == 1
+    assert "_summarize" in found[0].message
+    assert "float()" in found[0].message
+
+
+def test_taint_delivery_phase_helper_is_clean(tree):
+    tree.write("doorman_tpu/solver/fast.py", TAINT_GOOD)
+    assert tree.active(rules=["device-sync-taint"]) == []
+
+
+def test_taint_through_returning_helper(tree):
+    # Device-ness survives a helper RETURN: the branch two hops away
+    # from the jnp call is still a sync.
+    tree.write("doorman_tpu/solver/deep.py", """
+import jax.numpy as jnp
+
+
+def _mask(table):
+    return jnp.greater(table, 0)
+
+
+def _any_row(table):
+    m = _mask(table)
+    if m.any():
+        return 1
+    return 0
+
+
+class Engine:
+    def dispatch(self, table, ph):
+        n = _any_row(table)
+        ph.lap("staging")
+        return n
+""")
+    found = tree.active(rules=["device-sync-taint"])
+    assert len(found) == 1
+    assert "branching" in found[0].message
+
+
+def test_taint_host_metadata_is_clean(tree):
+    # .shape/.dtype are host attributes; branching on them is free.
+    tree.write("doorman_tpu/solver/meta.py", """
+import jax.numpy as jnp
+
+
+def _rows(table):
+    t = jnp.cumsum(table)
+    if t.shape[0] > 8:
+        return int(t.shape[0])
+    return 8
+
+
+class Engine:
+    def dispatch(self, table, ph):
+        n = _rows(table)
+        ph.lap("staging")
+        return n
+""")
+    assert tree.active(rules=["device-sync-taint"]) == []
+
+
+def test_taint_donated_buffer_reused(tree):
+    tree.write("doorman_tpu/solver/donate.py", """
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(table):
+    return table + 1
+
+
+def advance(table):
+    out = step(table)
+    return table.sum()
+""")
+    found = tree.active(rules=["device-sync-taint"])
+    assert len(found) == 1
+    assert "donated" in found[0].message
+
+
+def test_taint_donation_rebind_is_clean(tree):
+    tree.write("doorman_tpu/solver/donate.py", """
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(table):
+    return table + 1
+
+
+def advance(table):
+    table = step(table)
+    return table.sum()
+""")
+    assert tree.active(rules=["device-sync-taint"]) == []
+
+
+# ---------------------------------------------------------------------
+# registry-coherence
+# ---------------------------------------------------------------------
+
+
+def test_stale_phase_entry(tree):
+    # "warp" is budgeted by every consumer but no tick ever laps it.
+    tree.write("doorman_tpu/solver/engine.py", """
+PHASES = ("sweep", "solve", "warp")
+
+
+def tick(ph):
+    ph.lap("sweep")
+    ph.lap("solve")
+""")
+    found = tree.active(rules=["registry-coherence"])
+    assert len(found) == 1
+    assert "'warp'" in found[0].message
+    assert "never lapped" in found[0].message
+
+
+def test_live_registries_are_clean(tree):
+    tree.write("doorman_tpu/solver/engine.py", """
+PHASES = ("sweep", "solve")
+
+
+def tick(ph):
+    ph.lap("sweep")
+    ph.lap("solve")
+""")
+    tree.write("doorman_tpu/obs/trace.py", """
+KNOWN_SPAN_NAMES = frozenset({"server.tick", "server.*"})
+KNOWN_INSTANT_NAMES = frozenset({"shard.*"})
+""")
+    tree.write("doorman_tpu/server/handlers.py", """
+def handle(tracer, method):
+    with tracer.span("server.tick"):
+        with tracer.span(f"server.{method}"):
+            tracer.instant(f"shard.{method}")
+""")
+    assert tree.active(rules=["registry-coherence"]) == []
+
+
+def test_stale_span_and_wildcard_entries(tree):
+    tree.write("doorman_tpu/obs/trace.py", """
+KNOWN_SPAN_NAMES = frozenset({"server.tick", "persist.snapshot"})
+KNOWN_INSTANT_NAMES = frozenset({"federation.*"})
+""")
+    tree.write("doorman_tpu/server/handlers.py", """
+def handle(tracer):
+    with tracer.span("server.tick"):
+        pass
+""")
+    found = tree.active(rules=["registry-coherence"])
+    assert {m for f in found for m in [f.message]} and len(found) == 2
+    messages = " | ".join(f.message for f in found)
+    assert "persist.snapshot" in messages
+    assert "federation.*" in messages
+
+
+def test_ghost_tracked_writer_entry(tree):
+    tree.write("doorman_tpu/server/server.py", """
+FUSED_TRACKED_WRITERS = frozenset({"CapacityServer._decide"})
+
+
+class CapacityServer:
+    def _fused_invalidate(self):
+        pass
+""")
+    found = tree.active(rules=["registry-coherence"])
+    assert len(found) == 1
+    assert "CapacityServer._decide" in found[0].message
+
+
+def test_flightrec_read_without_producer(tree):
+    tree.write("doorman_tpu/obs/flightrec.py", """
+class FlightRecorder:
+    def record(self, **fields):
+        pass
+
+    def overlay(self, records):
+        out = []
+        for rec in records:
+            out.append(rec.get("phases"))
+            out.append(rec.get("wall_ms"))
+        return out
+""")
+    tree.write("doorman_tpu/server/server.py", """
+class Server:
+    def tick(self, fr, ms):
+        rec = {}
+        rec["wall_ms"] = ms
+        fr.record(**rec)
+""")
+    found = tree.active(rules=["registry-coherence"])
+    assert len(found) == 1
+    assert "'phases'" in found[0].message
+
+
+# ---------------------------------------------------------------------
+# import-derived determinism scope
+# ---------------------------------------------------------------------
+
+
+def test_determinism_scope_follows_imports_not_prefixes(tree):
+    # lib/ appears in no hand-kept list; it is covered the moment the
+    # chaos runner can reach it.
+    tree.write("doorman_tpu/lib/util.py", """
+import time
+
+
+def now():
+    return time.time()
+""")
+    tree.write("doorman_tpu/chaos/runner.py",
+               "from doorman_tpu.lib.util import now\n")
+    found = tree.active(rules=["seeded-determinism"])
+    assert len(found) == 1
+    assert found[0].path == "doorman_tpu/lib/util.py"
+
+
+def test_determinism_unreachable_module_is_exempt(tree):
+    tree.write("doorman_tpu/lib/util.py", """
+import time
+
+
+def now():
+    return time.time()
+""")
+    assert tree.active(rules=["seeded-determinism"]) == []
+
+
+def test_federation_is_derived_chaos_reachable():
+    # The PR-10 near-miss: the hand-kept list had to be extended for
+    # federation/ by review. The derivation must cover every one of its
+    # modules with no list to forget.
+    contexts, errors = load_files(REPO_ROOT)
+    assert errors == []
+    repo = RepoContext(REPO_ROOT, contexts)
+    reach = repo.graph.chaos_reachable()
+    fed = [p for p in repo.by_path if p.startswith("doorman_tpu/federation/")]
+    assert fed, "federation package disappeared?"
+    missing = [p for p in fed if p not in reach]
+    assert missing == []
+
+
+def test_hand_kept_chaos_list_is_gone():
+    from tools.lint.checkers import determinism
+
+    assert not hasattr(determinism, "CHAOS_REACHABLE")
+
+
+# ---------------------------------------------------------------------
+# operational gates
+# ---------------------------------------------------------------------
+
+
+def test_real_repo_clean_under_all_nine_rules():
+    from tools.lint.core import apply_baseline, load_baseline, default_checkers
+
+    assert len(default_checkers()) == 9
+    findings = run_lint(REPO_ROOT)
+    apply_baseline(
+        findings, load_baseline(REPO_ROOT / "tools" / "lint" / "baseline.json")
+    )
+    active = [f for f in findings if not f.suppressed and not f.baselined]
+    assert active == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in active
+    )
+
+
+def test_wall_clock_budget_and_no_jax_import():
+    # The lint job must stay a fast bare-CPU gate: the full nine-rule
+    # run over the real repo in under 10 s, without ever importing jax
+    # (fresh interpreter so this suite's own imports don't pollute).
+    code = (
+        "import sys, time; t0 = time.perf_counter();\n"
+        "from pathlib import Path;\n"
+        "from tools.lint.core import run_lint;\n"
+        f"fs = run_lint(Path({str(REPO_ROOT)!r}));\n"
+        "elapsed = time.perf_counter() - t0;\n"
+        "assert 'jax' not in sys.modules, 'lint imported jax';\n"
+        "assert 'numpy' not in sys.modules, 'lint imported numpy';\n"
+        "print(elapsed)\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    elapsed = float(res.stdout.strip().splitlines()[-1])
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_changed_only_filters_reporting(tree, capsys):
+    from tools.lint.cli import main
+
+    tree.write("doorman_tpu/chaos/t.py", "import time\nx = time.time()\n")
+    subprocess.run(["git", "init", "-q"], cwd=tree.root, check=True)
+    # Nothing committed: the file is untracked, i.e. changed.
+    rc = main(["--root", str(tree.root), "--rule", "seeded-determinism",
+               "--changed-only", "--no-baseline"])
+    assert rc == 1
+    subprocess.run(["git", "add", "-A"], cwd=tree.root, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "x"],
+        cwd=tree.root, check=True,
+    )
+    # Committed and unchanged: same findings exist, none are reported.
+    rc = main(["--root", str(tree.root), "--rule", "seeded-determinism",
+               "--changed-only", "--no-baseline"])
+    assert rc == 0
+    capsys.readouterr()

@@ -52,6 +52,22 @@ def test_counter_gauge_exposition():
     assert "temperature 36.5" in text
 
 
+def test_request_log_stamps_via_injected_clock():
+    # The clock is an injectable seam (doormanlint seeded-determinism):
+    # a chaos-driven server's samples must carry VIRTUAL time, and the
+    # explicit `when` override must win over the clock.
+    from doorman_tpu.obs.requests import RequestLog
+
+    t = [1000.0]
+    log = RequestLog(clock=lambda: t[0])
+    log.record("GetCapacity", "c1", ["r0"], 5.0, 0.01, False)
+    t[0] = 2000.0
+    log.record("Release", "c1", ["r0"], 0.0, 0.01, False, when=42.0)
+    newest, oldest = log.snapshot()
+    assert oldest.when == 1000.0
+    assert newest.when == 42.0
+
+
 def test_histogram_exposition():
     reg = Registry()
     h = reg.histogram("latency", buckets=(0.1, 1.0))

@@ -1,12 +1,21 @@
 """The doormanlint rule set. Each module holds one checker; ALL_CHECKERS
-is the registry the CLI and `run_lint` resolve by default."""
+is the registry the CLI and `run_lint` resolve by default.
+
+The first six are per-file (one ast at a time); the last three are the
+v2 whole-program rules built on tools/lint/graph.py + dataflow.py
+(lock-order, device-sync-taint, registry-coherence). seeded-determinism
+straddles: its checks are per-file but its scope is the import-graph
+derivation."""
 
 from tools.lint.checkers.determinism import SeededDeterminism
+from tools.lint.checkers.device_taint import DeviceSyncTaint
 from tools.lint.checkers.fused_writer import FusedWriterDiscipline
 from tools.lint.checkers.host_sync import HostSyncInHotPath
 from tools.lint.checkers.jit_capture import JitClosureCapture
+from tools.lint.checkers.lock_order import LockOrder
 from tools.lint.checkers.locks import LockDiscipline
 from tools.lint.checkers.phase_hygiene import TracePhaseHygiene
+from tools.lint.checkers.registries import RegistryCoherence
 
 ALL_CHECKERS = (
     JitClosureCapture,
@@ -15,6 +24,9 @@ ALL_CHECKERS = (
     SeededDeterminism,
     LockDiscipline,
     TracePhaseHygiene,
+    LockOrder,
+    DeviceSyncTaint,
+    RegistryCoherence,
 )
 
 __all__ = [
@@ -25,4 +37,7 @@ __all__ = [
     "SeededDeterminism",
     "LockDiscipline",
     "TracePhaseHygiene",
+    "LockOrder",
+    "DeviceSyncTaint",
+    "RegistryCoherence",
 ]

@@ -22,6 +22,16 @@ Genuinely wall-clock behavior (RPC deadlines against real sockets,
 election retry budgets) carries `# doorman: allow[seeded-determinism]`
 with its reason — the point is that every escape from virtual time is
 explicit and reviewed, not that none exist.
+
+Scope is DERIVED, not declared: a module is chaos-reachable when it is
+in the transitive import closure of the chaos runner, the serving
+stack, or the sim kernel (graph.CHAOS_ROOTS). The old hand-kept
+CHAOS_REACHABLE prefix list rotted exactly the way hand-kept lists do
+— `federation/` had to be added by review in PR 10, and a miss there
+would have silently exempted a whole subsystem from this contract. Now
+a new subsystem is covered the moment anything reachable imports it,
+and a module nothing can reach (loadtest drivers, cmd entry points)
+is exempt by construction instead of by omission.
 """
 
 from __future__ import annotations
@@ -30,24 +40,6 @@ import ast
 from typing import Iterator
 
 from tools.lint.core import Checker, FileContext, Finding, RepoContext, call_name
-
-# Module prefixes the chaos runner (or the sim kernel) can reach.
-CHAOS_REACHABLE = (
-    "doorman_tpu/server/",
-    "doorman_tpu/solver/",
-    "doorman_tpu/admission/",
-    "doorman_tpu/persist/",
-    "doorman_tpu/chaos/",
-    "doorman_tpu/sim/",
-    "doorman_tpu/client/",
-    "doorman_tpu/core/",
-    "doorman_tpu/ratelimiter/",
-    "doorman_tpu/utils/",
-    # The federated tree runs under the chaos runner (shard_partition):
-    # its reconcile beat, discovery jitter, and client fan-out must all
-    # draw time/randomness through the injectable seams.
-    "doorman_tpu/federation/",
-)
 
 _TIME_CALLS = {"time.time", "time.monotonic"}
 _DATETIME_CALLS = {
@@ -74,7 +66,11 @@ class SeededDeterminism(Checker):
     )
 
     def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
-        if not ctx.relpath.startswith(CHAOS_REACHABLE):
+        reachable = repo.cache.get(self.name)
+        if reachable is None:
+            reachable = repo.graph.chaos_reachable()
+            repo.cache[self.name] = reachable
+        if ctx.relpath not in reachable:
             return
         # The virtual clock itself documents/aliases time.time.
         if ctx.relpath.endswith("chaos/clock.py"):

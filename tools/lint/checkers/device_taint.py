@@ -1,0 +1,406 @@
+"""device-sync-taint: host syncs REACHABLE from a hot phase, plus
+donation safety.
+
+host-sync-in-hot-path sees a sync typed literally inside a
+PhaseRecorder-lapped segment. This rule upgrades the ROADMAP's
+fused-tick gate from "syncs typed inside the phase" to "syncs reachable
+from the phase": device values are tracked through assignments, returns
+and calls (tools/lint/dataflow.py), and a ``float()`` three helpers
+deep is attributed back to the tick phase that can reach it.
+
+  * **sources** — results of ``jnp.*`` / ``jax.*`` / ``lax.*`` /
+    ``pl.*`` calls; taint survives arithmetic, indexing, method calls
+    on a tainted receiver (``x.sum()``), tuple packing/unpacking, and
+    function returns (a helper returning a ``jnp`` expression taints
+    its callers' results, via a call-graph fixed point);
+  * **sinks** — implicit host syncs: ``float()/bool()/int()``,
+    ``.item()``, ``.tolist()``, ``np.asarray/np.array``,
+    ``jax.device_get``, ``.block_until_ready()``, branching on a
+    tainted value, and iterating one;
+  * **hot region** — call sites inside a lap-recording function's
+    non-delivery segments (same phase attribution as
+    host-sync-in-hot-path: a lap times the code above it; download /
+    apply are delivery) are roots; everything they can reach through
+    the approximate call graph is hot. Sinks in hot code are findings;
+    tainted arguments crossing into a callee parameter that sinks
+    inside the callee are reported at the call site (that's where the
+    device value escaped);
+  * **division of labor** — direct sinks lexically inside a
+    ``solver/`` lap function stay host-sync-in-hot-path findings (the
+    per-file rule already anchors them to an exact phase); this rule
+    reports everything the per-file rule cannot see: helpers, other
+    packages' lap functions (federation/aggregate.py), and the
+    call-crossing cases.
+
+Donation safety rides along: a function jitted with a literal
+``donate_argnums`` invalidates the donated arguments — referencing a
+donated name after the donating call (without rebinding it, as in
+``a, b = step(a, b)``) reads freed device memory and is flagged
+regardless of phase.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.lint.core import Checker, FileContext, Finding, RepoContext
+from tools.lint.checkers.host_sync import DELIVERY_PHASES, _lap_schedule, _phase_at
+from tools.lint.dataflow import DEVICE, FunctionTaint
+
+_SOURCE_PREFIXES = ("jnp.", "jax.", "lax.", "pl.", "pltpu.")
+# jax-namespace calls whose result is a host value (or no value):
+# naming them sources would taint strings and dtypes.
+_NOT_SOURCES = {
+    "jax.device_get", "jax.block_until_ready", "jax.debug.print",
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.process_index",
+    "jax.process_count", "jnp.dtype", "jnp.issubdtype", "jnp.result_type",
+    "jnp.shape", "jnp.ndim", "jax.eval_shape", "jax.tree_util.tree_map",
+}
+_MAX_SUMMARY_PASSES = 8
+
+
+def _param_names(func: ast.AST) -> List[str]:
+    a = func.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    return names
+
+
+class DeviceSyncTaint(Checker):
+    name = "device-sync-taint"
+    description = (
+        "device values tracked through calls: implicit host syncs "
+        "reachable from hot tick phases, and donated buffers used "
+        "after donation"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        analysis = repo.cache.get(self.name)
+        if analysis is None:
+            analysis = self._analyze(repo)
+            repo.cache[self.name] = analysis
+        for f in analysis.get(ctx.relpath, ()):
+            yield f
+
+    # -- whole-program pass --------------------------------------------
+
+    def _analyze(self, repo: RepoContext) -> Dict[str, List[Finding]]:
+        graph = repo.graph
+        findings: Dict[str, List[Finding]] = {}
+
+        def emit(ctx: FileContext, node: ast.AST, message: str) -> None:
+            findings.setdefault(ctx.relpath, []).append(
+                self.finding(ctx, node, message)
+            )
+
+        # ---- interprocedural taint summaries (fixed point) ----
+        summaries: Dict[tuple, dict] = {
+            fn.key: {"returns_device": False, "sink_params": {}}
+            for fn in graph.functions.values()
+        }
+
+        def is_source(call: ast.Call) -> bool:
+            try:
+                txt = ast.unparse(call.func)
+            except Exception:  # pragma: no cover
+                return False
+            if txt in _NOT_SOURCES:
+                return False
+            return txt.startswith(_SOURCE_PREFIXES)
+
+        # Engine device tables: self-attributes assigned from a device
+        # source anywhere in their class are device-origin at every
+        # read (the resident solvers' permanently-device-resident
+        # grants/wants tables).
+        device_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        for fn in graph.functions.values():
+            if fn.cls is None:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                sourced = any(
+                    isinstance(n, ast.Call) and is_source(n)
+                    for n in ast.walk(node.value)
+                )
+                if not sourced:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) and tgt.value.id == "self":
+                        device_attrs.setdefault(
+                            (fn.ctx.relpath, fn.cls), set()
+                        ).add(tgt.attr)
+
+        def make_is_device_attr(fn):
+            if fn.cls is None:
+                return None
+            attrs = device_attrs.get((fn.ctx.relpath, fn.cls))
+            if not attrs:
+                return None
+
+            def is_device_attr(node: ast.Attribute) -> bool:
+                return (isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in attrs)
+
+            return is_device_attr
+
+        call_targets: Dict[tuple, Dict[int, tuple]] = {
+            fn.key: {id(c): targets for c, targets in fn.calls}
+            for fn in graph.functions.values()
+        }
+
+        def make_oracles(fn):
+            resolved = call_targets[fn.key]
+
+            def targets_of(fn_, call: ast.Call):
+                return resolved.get(id(call), ())
+
+            def returns_device(call: ast.Call) -> bool:
+                return any(
+                    summaries[t.key]["returns_device"]
+                    for t in targets_of(fn, call)
+                )
+
+            def sink_for_arg(call: ast.Call, arg) -> Optional[tuple]:
+                for t in targets_of(fn, call):
+                    sp = summaries[t.key]["sink_params"]
+                    if not sp:
+                        continue
+                    if isinstance(arg, int):
+                        params = _param_names(t.node)
+                        if t.cls is not None and params[:1] == ["self"]:
+                            params = params[1:]
+                        if arg < len(params) and params[arg] in sp:
+                            reason, _ = sp[params[arg]]
+                            return reason, (t.qualname, t.ctx.relpath)
+                    elif arg in sp:
+                        reason, _ = sp[arg]
+                        return reason, (t.qualname, t.ctx.relpath)
+                return None
+
+            return returns_device, sink_for_arg
+
+        taints: Dict[tuple, FunctionTaint] = {}
+        for _ in range(_MAX_SUMMARY_PASSES):
+            changed = False
+            for fn in graph.functions.values():
+                returns_device, sink_for_arg = make_oracles(fn)
+                ft = FunctionTaint(
+                    fn.node,
+                    is_source=is_source,
+                    returns_device=returns_device,
+                    sink_for_arg=sink_for_arg,
+                    is_device_attr=make_is_device_attr(fn),
+                ).run()
+                taints[fn.key] = ft
+                s = summaries[fn.key]
+                rd = DEVICE in ft.returns
+                if rd and not s["returns_device"]:
+                    s["returns_device"] = True
+                    changed = True
+                for ev in ft.events:
+                    for origin in ev.origins:
+                        if origin == DEVICE or origin not in ft.param_names:
+                            continue
+                        if origin not in s["sink_params"]:
+                            s["sink_params"][origin] = (
+                                ev.reason, fn.qualname
+                            )
+                            changed = True
+            if not changed:
+                break
+
+        # ---- hot region ----
+        lap_fns = {}
+        for fn in graph.functions.values():
+            laps = _lap_schedule(fn.node)
+            if laps:
+                lap_fns[fn.key] = laps
+        hot_roots = []
+        for key, laps in lap_fns.items():
+            fn = graph.functions[key]
+            for call, targets in fn.calls:
+                phase = _phase_at(laps, call.lineno)
+                if phase is None or phase in DELIVERY_PHASES:
+                    continue
+                hot_roots.extend(targets)
+        hot = graph.transitive_callees(hot_roots)
+
+        # ---- findings ----
+        for fn in graph.functions.values():
+            ft = taints.get(fn.key)
+            if ft is None:
+                continue
+            is_root = fn.key in lap_fns
+            if not is_root and fn.key not in hot:
+                continue
+            laps = lap_fns.get(fn.key, [])
+            for ev in ft.events:
+                if DEVICE not in ev.origins:
+                    continue  # propagates via summaries, reported upward
+                if is_root:
+                    phase = _phase_at(laps, ev.node.lineno)
+                    if phase is None or phase in DELIVERY_PHASES:
+                        continue
+                    if ev.through is None and \
+                            fn.ctx.relpath.startswith("doorman_tpu/solver/"):
+                        # host-sync-in-hot-path's territory.
+                        continue
+                if ev.through is not None:
+                    qn, rel = ev.through
+                    emit(fn.ctx, ev.node,
+                         f"passes a device-origin value into {qn}() "
+                         f"({rel}), which host-syncs it via {ev.reason}: "
+                         "the sync is reachable from a hot tick phase — "
+                         "sync in delivery, or hand the helper host data",
+                         )
+                else:
+                    emit(fn.ctx, ev.node,
+                         f"{ev.reason} on a device-origin value in "
+                         f"{fn.qualname} (reachable from a hot tick "
+                         "phase): implicit host sync outside delivery — "
+                         "keep hot-phase helpers async against the "
+                         "device",
+                         )
+
+        # ---- donation safety (lexical, per file) ----
+        for ctx in repo.files:
+            for f in self._donation_findings(ctx):
+                findings.setdefault(ctx.relpath, []).append(f)
+        return findings
+
+    # -- donation ------------------------------------------------------
+
+    def _donation_findings(self, ctx: FileContext) -> List[Finding]:
+        donors = self._donating_callables(ctx)
+        if not donors:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_donation(ctx, node, donors))
+        return out
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = []
+                for elt in v.elts:
+                    if not (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, int)):
+                        return None  # computed: cannot know, stay quiet
+                    pos.append(elt.value)
+                return tuple(pos)
+            return None
+        return None
+
+    def _donating_callables(self, ctx: FileContext) -> Dict[str, Tuple[int, ...]]:
+        """Local names bound to a jit with literal donate_argnums:
+        decorated defs and `x = jax.jit(f, donate_argnums=...)`."""
+        donors: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    # Both spellings: @jax.jit(donate_argnums=...) and
+                    # @partial(jax.jit, donate_argnums=...).
+                    if isinstance(dec, ast.Call) and "jit" in ast.unparse(dec):
+                        pos = self._donated_positions(dec)
+                        if pos:
+                            donors[node.name] = pos
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                call = node.value
+                if "jit" not in ast.unparse(call):
+                    continue
+                pos = self._donated_positions(call)
+                if not pos:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        donors[tgt.id] = pos
+                    elif isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) and tgt.value.id == "self":
+                        donors[tgt.attr] = pos
+        return donors
+
+    def _check_donation(self, ctx: FileContext, func: ast.AST,
+                        donors: Dict[str, Tuple[int, ...]]) -> List[Finding]:
+        out: List[Finding] = []
+        dead: Dict[str, str] = {}  # name -> donating callee text
+
+        def callee_key(call: ast.Call) -> Optional[str]:
+            f = call.func
+            if isinstance(f, ast.Name) and f.id in donors:
+                return f.id
+            if isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name) and f.value.id == "self" and \
+                    f.attr in donors:
+                return f.attr
+            return None
+
+        def read(node: ast.expr) -> None:
+            # Statement granularity: reads in THIS expression happen
+            # before its own donating call completes (args evaluate
+            # first), so flag against the dead set as it stood, and
+            # only then retire the newly donated names.
+            newly_dead: Dict[str, str] = {}
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in dead:
+                    out.append(self.finding(
+                        ctx, n,
+                        f"{n.id} was donated to {dead[n.id]}() "
+                        "(donate_argnums) and is referenced afterwards: "
+                        "a donated buffer is freed by XLA at the call — "
+                        "rebind the result (`x = f(x)`) or drop the "
+                        "donation",
+                    ))
+                    del dead[n.id]  # one report per donation
+                elif isinstance(n, ast.Call):
+                    key = callee_key(n)
+                    if key is not None:
+                        for i in donors[key]:
+                            if i < len(n.args) and isinstance(
+                                    n.args[i], ast.Name):
+                                newly_dead[n.args[i].id] = key
+            dead.update(newly_dead)
+
+        def bind(tgt: ast.AST) -> None:
+            if isinstance(tgt, ast.Name):
+                dead.pop(tgt.id, None)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    bind(e)
+            elif isinstance(tgt, ast.Starred):
+                bind(tgt.value)
+
+        def exec_stmt(stmt: ast.AST) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                if stmt.value is not None:
+                    read(stmt.value)
+                tgts = (stmt.targets if isinstance(stmt, ast.Assign)
+                        else [stmt.target])
+                for t in tgts:
+                    bind(t)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    read(child)
+                elif isinstance(child, ast.stmt):
+                    exec_stmt(child)
+
+        for stmt in func.body:
+            exec_stmt(stmt)
+        return out

@@ -1,0 +1,549 @@
+"""lock-order: whole-program lock-acquisition analysis.
+
+PRs 9-10 made the server genuinely concurrent — stream outbound queues
+drained by gRPC handler tasks, the PipelinedTicker straddling the event
+loop and the tick executor, the federation reconcile beat running
+between processes — and the per-file lock-discipline rule cannot see
+the two bug classes that concurrency actually ships:
+
+  * **ordering cycles** — thread 1 holds ``A._lock`` and calls into a
+    function that takes ``B._lock``; thread 2 does the reverse. Each
+    file looks fine; the deadlock lives in the call graph.
+  * **blocking under a lock** — a gRPC call, ``Future.result()``, a
+    bounded ``queue.put`` (the 256-deep stream queues), ``time.sleep``
+    or a device sync executed while a lock is held turns every other
+    user of that lock into a hostage of the slow operation.
+
+Mechanics, all on the tools/lint/graph.py substrate:
+
+  * lock identity is class-scoped: ``self._lock`` inside class ``C``
+    is the node ``C._lock``; module globals are ``<module>._lock``.
+    Only KNOWN locks count — attributes assigned
+    ``threading.Lock/RLock/Condition()`` anywhere in the tree, plus
+    anything named by ``# guarded-by:`` / ``# holds-lock:`` markers —
+    so ``with tracer.span(...)`` and friends never register;
+  * held sets propagate lexically (``with`` nesting, the existing
+    ``# holds-lock:`` def annotation) and interprocedurally: a call
+    made while holding H adds edges H x acquires*(callee), where
+    acquires* is a fixed point over the approximate call graph;
+  * edges feed a digraph; any strongly-connected component with two or
+    more locks is reported ONCE (at its first edge site, naming the
+    full cycle), so one ``# doorman: allow[lock-order]`` with a reason
+    retires one cycle;
+  * blocking operations are classified syntactically (sleep, gRPC
+    stubs, ``.result()``, ``put`` on attributes assigned a BOUNDED
+    queue, ``wait`` on mined Condition/Event attributes, device syncs)
+    and propagate the same way, so a lock held across a call whose
+    callee's callee blocks is still caught.
+
+Class-scoped identity merges instances: two DIFFERENT objects of one
+class can interleave ``C._lock`` without deadlock, and a re-acquisition
+is only reported when the spelling pins the same object (``self.X``
+taken twice on a non-reentrant Lock). Cross-instance cycles through two
+classes are real regardless of instance identity, which is why the
+merge is the right default for the cycle rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.lint.core import (
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    enclosing_class,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_REENTRANT = {"threading.RLock", "RLock"}
+_QUEUE_CTORS = {
+    "queue.Queue", "asyncio.Queue", "Queue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+}
+_WAITABLE_CTORS = {
+    "threading.Condition", "threading.Event", "Condition", "Event",
+    "asyncio.Event",
+}
+# Dotted call names that block unconditionally.
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get() device sync",
+    "jax.block_until_ready": "jax.block_until_ready() device sync",
+}
+_BLOCKING_ATTRS = {
+    "result": "Future.result()",
+    "block_until_ready": ".block_until_ready() device sync",
+    "item": ".item() device sync",
+}
+
+
+def _ctor_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Call):
+        try:
+            return ast.unparse(node.func)
+        except Exception:  # pragma: no cover
+            return ""
+    return ""
+
+
+class _Locks:
+    """Repo-wide mined lock/queue/waitable vocabulary."""
+
+    def __init__(self, repo: RepoContext):
+        # (class name | module dotted, attr) -> reentrant?
+        self.locks: Dict[Tuple[str, str], bool] = {}
+        self.attr_owners: Dict[str, Set[str]] = {}  # attr -> owner set
+        self.bounded_queue_attrs: Set[str] = set()
+        self.waitable_attrs: Set[str] = set()
+        for ctx in repo.files:
+            self._mine(ctx)
+        # `# guarded-by:` / `# holds-lock:` markers name locks that may
+        # have no visible constructor (fixtures, injected locks).
+        for ctx in repo.files:
+            self._mine_markers(ctx)
+
+    def _module_id(self, ctx: FileContext) -> str:
+        mod = ctx.relpath[:-3].replace("/", ".")
+        return mod[:-9] if mod.endswith(".__init__") else mod
+
+    def _mine(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            ctor = _ctor_name(value)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if ctor in _LOCK_CTORS:
+                for tgt in targets:
+                    owner, attr = self._owner_attr(ctx, tgt)
+                    if owner is None or attr is None:
+                        continue
+                    self.locks[(owner, attr)] = ctor in _REENTRANT
+                    self.attr_owners.setdefault(attr, set()).add(owner)
+            if ctor in _QUEUE_CTORS and self._is_bounded(value):
+                for tgt in targets:
+                    _, attr = self._owner_attr(ctx, tgt)
+                    if attr:
+                        self.bounded_queue_attrs.add(attr)
+            if ctor in _WAITABLE_CTORS:
+                for tgt in targets:
+                    _, attr = self._owner_attr(ctx, tgt)
+                    if attr:
+                        self.waitable_attrs.add(attr)
+
+    @staticmethod
+    def _is_bounded(call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                if isinstance(kw.value, ast.Constant):
+                    return bool(kw.value.value)
+                return True  # maxsize=VAR: assume bounded
+        if call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant):
+                return bool(arg.value)
+            return True
+        return False
+
+    def _owner_attr(self, ctx: FileContext, tgt: ast.AST
+                    ) -> Tuple[Optional[str], Optional[str]]:
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            cls = enclosing_class(ctx, tgt)
+            return (cls.name if cls else None), tgt.attr
+        if isinstance(tgt, ast.Name):
+            return self._module_id(ctx), tgt.id
+        return None, None
+
+    def _mine_markers(self, ctx: FileContext) -> None:
+        import re
+
+        marker = re.compile(
+            r"#\s*(?:guarded-by|holds-lock):\s*([A-Za-z_][A-Za-z0-9_.]*)"
+        )
+        for text in ctx.lines:
+            m = marker.search(text)
+            if not m:
+                continue
+            attr = m.group(1).rsplit(".", 1)[-1]
+            if not any(attr == a for (_, a) in self.locks):
+                self.attr_owners.setdefault(attr, set())
+
+    # -- canonicalization ----------------------------------------------
+
+    def canon(self, ctx: FileContext, expr: ast.AST,
+              cls: Optional[str]) -> Optional[str]:
+        """Canonical lock id of a with-item / annotation expression, or
+        None when it is not a known lock."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if (attr not in self.attr_owners
+                    and not any(attr == a for (_, a) in self.locks)):
+                return None
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                owner = cls or "?"
+                return f"{owner}.{attr}"
+            owners = {
+                o for (o, a) in self.locks if a == attr
+            } | self.attr_owners.get(attr, set())
+            if len(owners) == 1:
+                return f"{next(iter(owners))}.{attr}"
+            return f"*.{attr}"
+        if isinstance(expr, ast.Name):
+            mod = self._module_id(ctx)
+            if (mod, expr.id) in self.locks:
+                return f"{mod}.{expr.id}"
+        return None
+
+    def canon_text(self, ctx: FileContext, text: str,
+                   cls: Optional[str]) -> Optional[str]:
+        try:
+            expr = ast.parse(text, mode="eval").body
+        except SyntaxError:
+            return None
+        return self.canon(ctx, expr, cls)
+
+    def reentrant(self, lock_id: str) -> bool:
+        owner, _, attr = lock_id.rpartition(".")
+        return self.locks.get((owner, attr), False)
+
+
+class _FnFacts:
+    """Per-function lexical facts for the fixed points."""
+
+    __slots__ = ("acquired", "edges", "calls", "blocking", "acq_site")
+
+    def __init__(self):
+        self.acquired: Set[str] = set()
+        # (src, dst, node, dst_text)
+        self.edges: List[Tuple[str, str, ast.AST, str]] = []
+        # (call node, frozenset held, targets, held_texts)
+        self.calls: List[tuple] = []
+        # (node, desc, frozenset held)
+        self.blocking: List[Tuple[ast.AST, str, frozenset]] = []
+        self.acq_site: Dict[str, ast.AST] = {}
+
+
+class LockOrder(Checker):
+    name = "lock-order"
+    description = (
+        "call-graph-propagated lock acquisition: ordering cycles "
+        "(potential deadlocks) and blocking calls under a held lock"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        analysis = repo.cache.get(self.name)
+        if analysis is None:
+            analysis = self._analyze(repo)
+            repo.cache[self.name] = analysis
+        for f in analysis.get(ctx.relpath, ()):
+            yield f
+
+    # -- whole-program pass --------------------------------------------
+
+    def _analyze(self, repo: RepoContext) -> Dict[str, List[Finding]]:
+        graph = repo.graph
+        locks = _Locks(repo)
+        facts: Dict[Tuple[str, str], _FnFacts] = {}
+        for fn in graph.functions.values():
+            facts[fn.key] = self._lexical(fn, locks)
+
+        acq = self._fixed_point(
+            graph, {k: set(f.acquired) for k, f in facts.items()},
+            lambda f: f.calls,
+            facts,
+        )
+        block = self._block_fixed_point(graph, facts)
+
+        findings: Dict[str, List[Finding]] = {}
+
+        def emit(ctx: FileContext, node: ast.AST, message: str) -> None:
+            findings.setdefault(ctx.relpath, []).append(
+                self.finding(ctx, node, message)
+            )
+
+        # Edge set: lexical + interprocedural.
+        edges: Dict[Tuple[str, str], Tuple[FileContext, ast.AST, str]] = {}
+        for fn in graph.functions.values():
+            f = facts[fn.key]
+            for src, dst, node, _ in f.edges:
+                edges.setdefault((src, dst), (fn.ctx, node, fn.qualname))
+            for call, held, targets, _ in f.calls:
+                deep: Set[str] = set()
+                for t in targets:
+                    deep |= acq.get(t.key, set())
+                for h in held:
+                    for l in deep:
+                        if l != h:
+                            edges.setdefault(
+                                (h, l), (fn.ctx, call, fn.qualname)
+                            )
+        # Re-acquisition of a non-reentrant lock pinned to one object.
+        for fn in graph.functions.values():
+            f = facts[fn.key]
+            for src, dst, node, dst_text in f.edges:
+                if src == dst and not locks.reentrant(src) and \
+                        dst_text.startswith("self."):
+                    emit(fn.ctx, node,
+                         f"{dst_text} ({src}) is acquired while already "
+                         "held by this function: a non-reentrant Lock "
+                         "self-deadlocks here",
+                         )
+            for call, held, targets, _ in f.calls:
+                for t in targets:
+                    again = held & acq.get(t.key, set())
+                    for l in again:
+                        if locks.reentrant(l):
+                            continue
+                        if not (isinstance(call.func, ast.Attribute)
+                                and isinstance(call.func.value, ast.Name)
+                                and call.func.value.id == "self"):
+                            continue
+                        if not l.startswith(f"{fn.cls}."):
+                            continue
+                        emit(fn.ctx, call,
+                             f"calls {t.qualname}() while holding {l}, "
+                             f"and {t.qualname} acquires {l} again: a "
+                             "non-reentrant Lock self-deadlocks "
+                             "(annotate the callee with # holds-lock: "
+                             "or narrow this critical section)",
+                             )
+
+        # Ordering cycles: one finding per SCC of the lock digraph.
+        adj: Dict[str, Set[str]] = {}
+        for (src, dst) in edges:
+            if src != dst:
+                adj.setdefault(src, set()).add(dst)
+                adj.setdefault(dst, set())
+        for scc in self._sccs(adj):
+            if len(scc) < 2:
+                continue
+            scc_set = set(scc)
+            sites = sorted(
+                (
+                    (ectx.relpath, node.lineno, ectx, node, src, dst, qn)
+                    for (src, dst), (ectx, node, qn) in edges.items()
+                    if src in scc_set and dst in scc_set
+                ),
+                key=lambda t: (t[0], t[1]),
+            )
+            if not sites:
+                continue
+            _, _, ectx, node, src, dst, qn = sites[0]
+            others = "; ".join(
+                f"{s}->{d} at {p}:{ln}" for p, ln, _, _, s, d, _ in sites[1:]
+            ) or "same-function nesting"
+            emit(ectx, node,
+                 f"lock-order cycle {{{', '.join(sorted(scc_set))}}}: "
+                 f"{qn} acquires {dst} while holding {src}, but the "
+                 f"reverse order also exists ({others}) — two threads "
+                 "taking these locks in opposite orders deadlock; pick "
+                 "one global order (doc/lint.md lock-order)",
+                 )
+
+        # Blocking under a lock.
+        for fn in graph.functions.values():
+            f = facts[fn.key]
+            for node, desc, held in f.blocking:
+                if not held:
+                    continue
+                locks_txt = ", ".join(sorted(held))
+                emit(fn.ctx, node,
+                     f"{desc} while holding {locks_txt}: every other "
+                     "user of the lock now waits on this blocking "
+                     "operation — move it outside the critical section",
+                     )
+            for call, held, targets, _ in f.calls:
+                if not held:
+                    continue
+                for t in targets:
+                    for desc, origin in sorted(block.get(t.key, set())):
+                        locks_txt = ", ".join(sorted(held))
+                        emit(fn.ctx, call,
+                             f"calls {t.qualname}() while holding "
+                             f"{locks_txt}, and it reaches {desc} (in "
+                             f"{origin}): the lock is held across a "
+                             "blocking operation",
+                             )
+        return findings
+
+    # -- lexical facts --------------------------------------------------
+
+    def _lexical(self, fn, locks: _Locks) -> _FnFacts:
+        f = _FnFacts()
+        ctx, func, cls = fn.ctx, fn.node, fn.cls
+        entry: Set[str] = set()
+        marker = ctx.holds_marker(func)
+        if marker:
+            held0 = locks.canon_text(ctx, marker, cls)
+            if held0:
+                entry.add(held0)
+
+        def visit(node: ast.AST, held: Set[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not func:
+                return  # separate call-graph node; no lexical inherit
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    visit(item.context_expr, inner)
+                    lock_id = locks.canon(ctx, item.context_expr, cls)
+                    if lock_id is None:
+                        continue
+                    try:
+                        txt = ast.unparse(item.context_expr)
+                    except Exception:  # pragma: no cover
+                        txt = lock_id
+                    f.acquired.add(lock_id)
+                    f.acq_site.setdefault(lock_id, node)
+                    for h in inner:
+                        f.edges.append((h, lock_id, node, txt))
+                    inner = inner | {lock_id}
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                desc = self._blocking_desc(node, locks)
+                if desc:
+                    f.blocking.append((node, desc, frozenset(held)))
+                f.calls.append((node, frozenset(held), (), ()))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(func, entry)
+        # Resolve call targets through the graph (fn.calls was built by
+        # RepoGraph; join on the call node identity).
+        resolved = {id(c): targets for c, targets in fn.calls}
+        f.calls = [
+            (c, held, resolved.get(id(c), ()), ())
+            for (c, held, _, _) in f.calls
+        ]
+        return f
+
+    @staticmethod
+    def _blocking_desc(call: ast.Call, locks: _Locks) -> Optional[str]:
+        func = call.func
+        try:
+            txt = ast.unparse(func)
+        except Exception:  # pragma: no cover
+            txt = ""
+        if txt in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[txt]
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr in _BLOCKING_ATTRS:
+                return _BLOCKING_ATTRS[attr]
+            recv = func.value
+            recv_attr = None
+            if isinstance(recv, ast.Attribute):
+                recv_attr = recv.attr
+            elif isinstance(recv, ast.Name):
+                recv_attr = recv.id
+            if attr == "put" and recv_attr in locks.bounded_queue_attrs:
+                return f"bounded queue.put on {recv_attr!r}"
+            if attr == "wait" and recv_attr in locks.waitable_attrs:
+                return f".wait() on {recv_attr!r}"
+            if recv_attr and recv_attr.lower().endswith("stub"):
+                return f"gRPC call {txt}()"
+        return None
+
+    # -- fixed points ---------------------------------------------------
+
+    @staticmethod
+    def _fixed_point(graph, init, calls_of, facts):
+        acq = init
+        for _ in range(32):
+            changed = False
+            for fn in graph.functions.values():
+                cur = acq[fn.key]
+                add: Set[str] = set()
+                for _, _, targets, _ in facts[fn.key].calls:
+                    for t in targets:
+                        add |= acq.get(t.key, set())
+                if not add <= cur:
+                    acq[fn.key] = cur | add
+                    changed = True
+            if not changed:
+                break
+        return acq
+
+    def _block_fixed_point(self, graph, facts):
+        block: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {
+            fn.key: {
+                (desc, fn.qualname)
+                for _, desc, _ in facts[fn.key].blocking
+            }
+            for fn in graph.functions.values()
+        }
+        for _ in range(32):
+            changed = False
+            for fn in graph.functions.values():
+                cur = block[fn.key]
+                add: Set[Tuple[str, str]] = set()
+                for _, held, targets, _ in facts[fn.key].calls:
+                    for t in targets:
+                        add |= block.get(t.key, set())
+                if not add <= cur:
+                    block[fn.key] = cur | add
+                    changed = True
+            if not changed:
+                break
+        return block
+
+    @staticmethod
+    def _sccs(adj: Dict[str, Set[str]]) -> List[List[str]]:
+        """Iterative Tarjan."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        for root in sorted(adj):
+            if root in index:
+                continue
+            work = [(root, iter(sorted(adj.get(root, ()))))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    out.append(scc)
+        return out

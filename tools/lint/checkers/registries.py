@@ -1,0 +1,297 @@
+"""registry-coherence: multi-file registries cross-checked against use.
+
+The repo's telemetry and contract registries are plain literals next to
+the code they govern (engine PHASES, obs KNOWN_SPAN_NAMES /
+KNOWN_INSTANT_NAMES, server FUSED_TRACKED_WRITERS), and the flight
+recorder's Chrome overlay reads record fields by string key. The
+forward direction is enforced (trace-phase-hygiene: every name used
+must be registered); this rule machine-checks the REVERSE direction,
+where today's drift is silent:
+
+  * a ``PHASES`` entry no ``ph.lap("...")`` ever records — stale
+    vocabulary every consumer (bench, SLO engine, flight recorder)
+    still budgets for;
+  * a concrete ``KNOWN_SPAN_NAMES`` / ``KNOWN_INSTANT_NAMES`` entry no
+    ``.span("...")`` / ``.instant("...")`` ever opens (wildcard
+    ``prefix.*`` entries are checked against computed f-string
+    prefixes too) — a route table documenting telemetry that does not
+    exist;
+  * a ``FUSED_TRACKED_WRITERS`` entry whose ``Class.method`` no longer
+    exists in the tree — an audited exemption pointing at nothing;
+  * a field the flight recorder's overlay READS (``rec.get("k")``,
+    or ``for k in ("a", "b"): ... rec[k]``) that no producer ever
+    WRITES (``record(k=...)`` keywords, or ``rec["k"] = ...`` stores
+    in a function that ends in ``record(**rec)``) — a dashboard lane
+    that will never light up.
+
+Findings land on the registry entry's own line (or the stale read), so
+the fix is local: delete the entry, or re-wire the producer and keep
+it. Suppress with ``# doorman: allow[registry-coherence] <reason>`` on
+the entry line for vocabulary that is intentionally ahead of the code
+(e.g. a wire format the next PR starts emitting).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.lint.core import (
+    Checker,
+    FileContext,
+    Finding,
+    RepoContext,
+    _REGISTRY_NAMES,
+)
+
+_FLIGHTREC_FILE = "doorman_tpu/obs/flightrec.py"
+# record() itself stamps seq; `t` is the time axis every producer sets.
+_FLIGHTREC_IMPLICIT = {"seq"}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.JoinedStr) and node.values and \
+            isinstance(node.values[0], ast.Constant):
+        return str(node.values[0].value)
+    return None
+
+
+class _Usage:
+    """Repo-wide mined usage: names recorded/opened, flightrec fields."""
+
+    def __init__(self, repo: RepoContext):
+        self.laps: Set[str] = set()
+        self.spans: Set[str] = set()
+        self.span_prefixes: Set[str] = set()
+        self.instants: Set[str] = set()
+        self.instant_prefixes: Set[str] = set()
+        self.flightrec_writes: Set[str] = set()
+        self.flightrec_reads: Dict[str, ast.AST] = {}
+        for ctx in repo.files:
+            self._mine(ctx)
+
+    def _mine(self, ctx: FileContext) -> None:
+        # Dicts that are splatted into a .record(**rec) call anywhere in
+        # this file: their string-subscript stores are producer writes.
+        splat_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "record":
+                for kw in node.keywords:
+                    if kw.arg:
+                        self.flightrec_writes.add(kw.arg)
+                    elif isinstance(kw.value, ast.Name):
+                        splat_names.add(kw.value.id)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and isinstance(
+                            tgt.value, ast.Name) and \
+                            tgt.value.id in splat_names:
+                        key = _const_str(tgt.slice)
+                        if key:
+                            self.flightrec_writes.add(key)
+                # rec[key] = ... inside `for key in ("a", "b"):`
+            elif isinstance(node, ast.For):
+                keys = self._loop_keys(node)
+                if keys and self._loop_subscripts(node, splat_names):
+                    self.flightrec_writes.update(keys)
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                attr = node.func.attr
+                first = node.args[0] if node.args else None
+                if attr == "lap" and first is not None:
+                    name = _const_str(first)
+                    if name:
+                        self.laps.add(name)
+                elif attr == "record" and first is not None:
+                    # PhaseRecorder.record(phase, seconds): positional
+                    # string first arg (flightrec.record is kw-only).
+                    name = _const_str(first)
+                    if name:
+                        self.laps.add(name)
+                elif attr == "span" and first is not None:
+                    name = _const_str(first)
+                    if name:
+                        self.spans.add(name)
+                    prefix = _fstring_prefix(first)
+                    if prefix:
+                        self.span_prefixes.add(prefix)
+                elif attr == "instant" and first is not None:
+                    name = _const_str(first)
+                    if name:
+                        self.instants.add(name)
+                    prefix = _fstring_prefix(first)
+                    if prefix:
+                        self.instant_prefixes.add(prefix)
+
+        if ctx.relpath == _FLIGHTREC_FILE:
+            self._mine_reads(ctx)
+
+    @staticmethod
+    def _loop_keys(node: ast.For) -> Optional[List[str]]:
+        if not isinstance(node.iter, (ast.Tuple, ast.List)):
+            return None
+        keys = []
+        for elt in node.iter.elts:
+            s = _const_str(elt)
+            if s is None:
+                return None
+            keys.append(s)
+        return keys
+
+    @staticmethod
+    def _loop_subscripts(node: ast.For, splat_names: Set[str]) -> bool:
+        if not isinstance(node.target, ast.Name):
+            return False
+        var = node.target.id
+        for n in ast.walk(node):
+            if isinstance(n, ast.Subscript) and isinstance(
+                    n.slice, ast.Name) and n.slice.id == var and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in splat_names:
+                return True
+        return False
+
+    def _mine_reads(self, ctx: FileContext) -> None:
+        """String keys the flight recorder pulls out of records."""
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "get" \
+                    and node.args:
+                key = _const_str(node.args[0])
+                if key:
+                    self.flightrec_reads.setdefault(key, node)
+            elif isinstance(node, ast.For):
+                keys = self._loop_keys(node)
+                if not keys or not isinstance(node.target, ast.Name):
+                    continue
+                var = node.target.id
+                uses_var_key = any(
+                    (isinstance(n, ast.Subscript)
+                     and isinstance(n.slice, ast.Name)
+                     and n.slice.id == var)
+                    or (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "get" and n.args
+                        and isinstance(n.args[0], ast.Name)
+                        and n.args[0].id == var)
+                    for n in ast.walk(node)
+                )
+                if uses_var_key:
+                    for k in keys:
+                        self.flightrec_reads.setdefault(k, node)
+
+
+class RegistryCoherence(Checker):
+    name = "registry-coherence"
+    description = (
+        "registry entries cross-checked against real use: stale PHASES "
+        "/ span / instant names, ghost FUSED_TRACKED_WRITERS entries, "
+        "flightrec fields read but never recorded"
+    )
+
+    def run(self, ctx: FileContext, repo: RepoContext) -> Iterator[Finding]:
+        analysis = repo.cache.get(self.name)
+        if analysis is None:
+            analysis = self._analyze(repo)
+            repo.cache[self.name] = analysis
+        for f in analysis.get(ctx.relpath, ()):
+            yield f
+
+    def _analyze(self, repo: RepoContext) -> Dict[str, List[Finding]]:
+        use = _Usage(repo)
+        findings: Dict[str, List[Finding]] = {}
+
+        def emit(ctx: FileContext, node: ast.AST, message: str) -> None:
+            findings.setdefault(ctx.relpath, []).append(
+                self.finding(ctx, node, message)
+            )
+
+        for ctx in repo.files:
+            for name, elt, value in self._registry_entries(ctx):
+                if name == "PHASES":
+                    if value not in use.laps:
+                        emit(ctx, elt,
+                             f"PHASES entry {value!r} is never lapped "
+                             "(no ph.lap/record call records it): stale "
+                             "vocabulary — delete it or wire the phase",
+                             )
+                elif name == "KNOWN_SPAN_NAMES":
+                    self._check_obs_entry(
+                        emit, ctx, elt, value, "span",
+                        use.spans, use.span_prefixes,
+                    )
+                elif name == "KNOWN_INSTANT_NAMES":
+                    self._check_obs_entry(
+                        emit, ctx, elt, value, "instant",
+                        use.instants, use.instant_prefixes,
+                    )
+                elif name == "FUSED_TRACKED_WRITERS":
+                    if not repo.graph.has_qualname(value):
+                        emit(ctx, elt,
+                             f"FUSED_TRACKED_WRITERS entry {value!r} "
+                             "names no function in the tree: the "
+                             "audited exemption points at nothing — "
+                             "remove it (or fix the qualname)",
+                             )
+
+        fr_ctx = repo.by_path.get(_FLIGHTREC_FILE)
+        if fr_ctx is not None:
+            for key, node in sorted(use.flightrec_reads.items()):
+                if key in _FLIGHTREC_IMPLICIT or \
+                        key in use.flightrec_writes:
+                    continue
+                emit(fr_ctx, node,
+                     f"flight-recorder overlay reads field {key!r} "
+                     "but no producer ever records it (no record("
+                     f"{key}=...) and no rec[{key!r}] = ... feeding a "
+                     "record(**...) call): dead dashboard lane",
+                     )
+        return findings
+
+    @staticmethod
+    def _check_obs_entry(emit, ctx, elt, value, kind, used, prefixes):
+        if value.endswith(".*"):
+            stem = value[:-1]  # "server." from "server.*"
+            if not any(p.startswith(stem) for p in prefixes) and \
+                    not any(u.startswith(stem) for u in used):
+                emit(ctx, elt,
+                     f"wildcard {kind} registry entry {value!r} matches "
+                     f"no opened {kind} and no computed f\"{stem}"
+                     "{...}\" name: stale vocabulary",
+                     )
+        elif value not in used:
+            emit(ctx, elt,
+                 f"{kind} registry entry {value!r} is never opened "
+                 f"(no .{kind}({value!r}) anywhere): stale vocabulary "
+                 "— delete it or wire the emitter",
+                 )
+
+    @staticmethod
+    def _registry_entries(ctx: FileContext
+                          ) -> Iterator[Tuple[str, ast.AST, str]]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if name not in _REGISTRY_NAMES:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and isinstance(
+                    value.func, ast.Name) and value.func.id in (
+                        "frozenset", "set") and len(value.args) == 1:
+                value = value.args[0]
+            if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                continue
+            for elt in value.elts:
+                s = _const_str(elt)
+                if s is not None:
+                    yield name, elt, s

@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="rewrite the baseline from the current findings and exit 0",
     )
     p.add_argument(
+        "--changed-only", action="store_true",
+        help="report findings only in files changed per git (worktree "
+             "+ index vs HEAD); the whole-program graphs are still "
+             "built from the full tree — the pre-commit entry point",
+    )
+    p.add_argument(
         "--show-suppressed", action="store_true",
         help="also print allow[]-suppressed and baselined findings",
     )
@@ -75,6 +81,41 @@ def detect_root(explicit: "str | None") -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def changed_files(root: Path) -> "set[str] | None":
+    """Repo-relative paths git reports as changed (worktree + index vs
+    HEAD, plus untracked); None when git is unavailable. Whole-program
+    analyses still see the full tree — this only scopes REPORTING, so
+    a changed helper still surfaces the lock cycle it closes."""
+    import subprocess
+
+    out: "set[str]" = set()
+    try:
+        has_head = subprocess.run(
+            ["git", "-C", str(root), "rev-parse", "--verify", "HEAD"],
+            capture_output=True, text=True, timeout=30,
+        ).returncode == 0
+        cmds = [
+            ["git", "-C", str(root), "ls-files",
+             "--others", "--exclude-standard"],
+        ]
+        if has_head:
+            cmds.append(
+                ["git", "-C", str(root), "diff", "--name-only", "HEAD"]
+            )
+        else:  # unborn branch: everything staged is new
+            cmds.append(["git", "-C", str(root), "ls-files"])
+        for args in cmds:
+            res = subprocess.run(
+                args, capture_output=True, text=True, timeout=30,
+            )
+            if res.returncode != 0:
+                return None
+            out.update(l.strip() for l in res.stdout.splitlines() if l.strip())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
@@ -87,6 +128,13 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.changed_only:
+        changed = changed_files(root)
+        if changed is None:
+            print("error: --changed-only needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.path in changed]
 
     baseline_path = root / (args.baseline or DEFAULT_BASELINE)
     if args.write_baseline:

@@ -158,13 +158,29 @@ class RepoContext:
     def __init__(self, root: Path, files: Sequence[FileContext]):
         self.root = root
         self.files = list(files)
+        self.by_path: Dict[str, FileContext] = {f.relpath: f for f in files}
         self.int_enum_classes: Set[str] = set()
         self.phases: Set[str] = set()
         self.span_names: Set[str] = set()
         self.instant_names: Set[str] = set()
         self.tracked_writers: Set[str] = set()
+        # Whole-program checkers memoize their one-shot analyses here,
+        # keyed by rule name (the runner calls run() once per file).
+        self.cache: Dict[str, object] = {}
+        self._graph = None
         for ctx in self.files:
             self._mine(ctx)
+
+    @property
+    def graph(self):
+        """The whole-program substrate (tools/lint/graph.py), built on
+        first use so rule-filtered runs of the per-file checkers don't
+        pay for it."""
+        if self._graph is None:
+            from tools.lint.graph import RepoGraph
+
+            self._graph = RepoGraph(self.files)
+        return self._graph
 
     def _mine(self, ctx: FileContext) -> None:
         for node in ast.walk(ctx.tree):

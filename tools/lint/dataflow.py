@@ -1,0 +1,410 @@
+"""Forward may-taint dataflow over one function body.
+
+The device-sync taint rule needs to answer "can this expression hold a
+device value here?" — which means tracking assignments, not just
+spotting call spellings. This is a small abstract interpreter over the
+statement list of one function:
+
+  * the abstract value of a variable is its **origin set** — a set of
+    labels: the distinguished DEVICE label (the value came from a
+    ``jnp.*``/``jax.*`` computation) and/or parameter names (the value
+    flows from that parameter, so the caller decides);
+  * statements are interpreted in source order; branches are analyzed
+    with copies of the state and merged by union (may-analysis); loop
+    bodies get a second pass so taint fed back through the loop header
+    is seen (two passes reach the fixed point for sets that only grow);
+  * nested defs are skipped — they are their own functions in the call
+    graph — and ``del``/strong updates remove taint (assigning a fresh
+    host value to a name cleans it).
+
+Interprocedural facts come in through two callbacks supplied by the
+checker (which owns the call-graph fixed point): does this call return
+a device value, and which parameters of this call's target flow into a
+host sync inside it. The walker reports events — host-sync sinks and
+tainted arguments crossing into sink parameters — through ``on_sink``;
+the checker decides which events are findings (only hot-reachable code
+is, and the DEVICE label vs parameter labels decide where to report).
+
+Everything here is checker-agnostic plumbing; the device vocabulary
+(what is a source, what is a sink) lives with the checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+DEVICE = "<device>"
+
+# Host conversions that synchronize when fed a device value. Each maps
+# to the reason fragment used in findings. (len() is absent on purpose:
+# array shapes are static under jax, len never blocks.)
+SINK_NAME_CALLS = {
+    "float": "float()",
+    "bool": "bool()",
+    "int": "int()",
+}
+SINK_ATTR_CALLS = {
+    "item": ".item()",
+    "tolist": ".tolist()",
+    "block_until_ready": ".block_until_ready()",
+}
+SINK_DOTTED_CALLS = {
+    "np.asarray": "np.asarray()",
+    "np.array": "np.array()",
+    "numpy.asarray": "numpy.asarray()",
+    "numpy.array": "numpy.array()",
+    "jax.device_get": "jax.device_get()",
+    "jax.block_until_ready": "jax.block_until_ready()",
+}
+
+# Array metadata that lives on the host under jax: reading it never
+# syncs and the result is a plain Python value.
+HOST_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "itemsize", "nbytes",
+    "weak_type", "sharding", "device",
+})
+# Methods whose result is host metadata even on a device receiver.
+HOST_RESULT_METHODS = frozenset({"devices", "platform", "is_deleted"})
+
+
+class SinkEvent:
+    """One place a (possibly) device-origin value hits the host."""
+
+    __slots__ = ("node", "reason", "origins", "through")
+
+    def __init__(self, node: ast.AST, reason: str,
+                 origins: FrozenSet[str],
+                 through: Optional[Tuple[str, str]] = None):
+        self.node = node            # where to report
+        self.reason = reason        # "float()", "branching", ...
+        self.origins = origins      # DEVICE and/or parameter names
+        self.through = through      # (callee qualname, callee path) when
+        #                             the sink is inside a callee
+
+
+class FunctionTaint:
+    """Interpret one function; collect SinkEvents and a summary.
+
+    `returns_device(call)` / `sink_for_arg(call, arg)` are the
+    checker's call-graph oracles: whether the call's resolved target
+    returns a device value, and — for a positional index or keyword
+    name — the (reason, (callee qualname, callee path)) pair when that
+    argument flows into a host sync inside the target (None otherwise).
+    The checker owns call resolution and positional->parameter mapping.
+    """
+
+    def __init__(
+        self,
+        func: ast.AST,
+        *,
+        is_source: Callable[[ast.Call], bool],
+        returns_device: Callable[[ast.Call], bool],
+        sink_for_arg: Callable[
+            [ast.Call, object], Optional[Tuple[str, Tuple[str, str]]]
+        ],
+        is_device_attr: Optional[Callable[[ast.Attribute], bool]] = None,
+        param_seed: Optional[Set[str]] = None,
+    ):
+        self.func = func
+        self.is_source = is_source
+        self.returns_device = returns_device
+        self.sink_for_arg = sink_for_arg
+        self.is_device_attr = is_device_attr
+        self.events: List[SinkEvent] = []
+        self._seen_events: Set[Tuple[int, str]] = set()
+        self.returns: Set[str] = set()  # origin labels of returned values
+        self._env: Dict[str, Set[str]] = {}
+        args = func.args
+        all_args = list(args.posonlyargs) + list(args.args) + \
+            list(args.kwonlyargs)
+        if args.vararg:
+            all_args.append(args.vararg)
+        if args.kwarg:
+            all_args.append(args.kwarg)
+        self.param_names = [a.arg for a in all_args]
+        for p in (param_seed if param_seed is not None else self.param_names):
+            self._env[p] = {p}
+
+    # -- driving --------------------------------------------------------
+
+    def run(self) -> "FunctionTaint":
+        self._exec_block(list(self.func.body), self._env)
+        return self
+
+    def _event(self, node: ast.AST, reason: str, origins: Set[str],
+               through: Optional[Tuple[str, str]] = None) -> None:
+        key = (id(node), reason)
+        if key in self._seen_events:
+            return
+        self._seen_events.add(key)
+        self.events.append(SinkEvent(node, reason, frozenset(origins),
+                                     through=through))
+
+    # -- statement interpretation --------------------------------------
+
+    def _exec_block(self, stmts, env: Dict[str, Set[str]]) -> None:
+        for stmt in stmts:
+            self._exec(stmt, env)
+
+    def _exec(self, stmt: ast.AST, env: Dict[str, Set[str]]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate call-graph nodes
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            origins = self._eval(value, env) if value is not None else set()
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            if isinstance(stmt, ast.AugAssign):
+                origins |= self._eval(stmt.target, env)
+            for tgt in targets:
+                self._bind(tgt, origins, env)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns |= self._eval(stmt.value, env)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env)
+            return
+        if isinstance(stmt, ast.If):
+            self._test(stmt.test, env)
+            then_env = {k: set(v) for k, v in env.items()}
+            else_env = {k: set(v) for k, v in env.items()}
+            self._exec_block(stmt.body, then_env)
+            self._exec_block(stmt.orelse, else_env)
+            self._merge(env, then_env, else_env)
+            return
+        if isinstance(stmt, (ast.While,)):
+            self._test(stmt.test, env)
+            self._exec_block(stmt.body, env)
+            self._exec_block(stmt.body, env)  # loop-carried taint
+            self._test(stmt.test, env)
+            self._exec_block(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            origins = self._eval(stmt.iter, env)
+            if origins:
+                self._event(stmt.iter, "iteration", origins)
+            self._bind(stmt.target, set(origins), env)
+            # Loop bodies run twice so loop-carried taint (a name
+            # tainted late, read early next iteration) is seen; event
+            # dedupe keeps reports single.
+            self._exec_block(stmt.body, env)
+            self._exec_block(stmt.body, env)
+            self._exec_block(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                origins = self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, set(origins), env)
+            self._exec_block(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, env)
+            for handler in stmt.handlers:
+                h_env = {k: set(v) for k, v in env.items()}
+                self._exec_block(handler.body, h_env)
+                self._merge(env, h_env, env)
+            self._exec_block(stmt.orelse, env)
+            self._exec_block(stmt.finalbody, env)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    env.pop(tgt.id, None)
+            return
+        if isinstance(stmt, (ast.Assert, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env)
+            return
+        # Everything else (pass, break, import, global, ...): no-op.
+
+    @staticmethod
+    def _merge(env, a, b) -> None:
+        for k in set(a) | set(b):
+            u = a.get(k, set()) | b.get(k, set())
+            if u:
+                env[k] = u
+            else:
+                env.pop(k, None)
+
+    def _bind(self, tgt: ast.AST, origins: Set[str],
+              env: Dict[str, Set[str]]) -> None:
+        if isinstance(tgt, ast.Name):
+            if origins:
+                env[tgt.id] = set(origins)
+            else:
+                env.pop(tgt.id, None)  # strong update: host value cleans
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._bind(elt, set(origins), env)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, set(origins), env)
+        # Attribute/subscript stores: not tracked (field-insensitive).
+
+    def _test(self, test: ast.expr, env: Dict[str, Set[str]]) -> None:
+        origins = self._eval(test, env)
+        if origins:
+            self._event(test, "branching", origins)
+
+    # -- expression evaluation -----------------------------------------
+
+    def _eval(self, node: ast.expr, env: Dict[str, Set[str]]) -> Set[str]:
+        if isinstance(node, ast.Name):
+            return set(env.get(node.id, ()))
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Attribute):
+            # Host metadata (x.shape, x.dtype, ...) is a plain Python
+            # value; any other attribute of a tainted value propagates
+            # (x.T, x.at, ...). The is_device_attr hook lets the
+            # checker name known device tables (self._wants, ...).
+            if node.attr in HOST_ATTRS:
+                self._eval(node.value, env)
+                return set()
+            out = self._eval(node.value, env)
+            if not out and self.is_device_attr is not None and \
+                    self.is_device_attr(node):
+                out = {DEVICE}
+            return out
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env)
+            self._eval(node.slice, env)
+            return base
+        if isinstance(node, ast.BinOp):
+            return self._eval(node.left, env) | self._eval(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self._eval(v, env)
+            return out
+        if isinstance(node, ast.Compare):
+            out = self._eval(node.left, env)
+            for c in node.comparators:
+                out |= self._eval(c, env)
+            # `x is None` / `x is not y` compares identity on the host;
+            # no device bool materializes.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return set()
+            return out
+        if isinstance(node, ast.IfExp):
+            self._test(node.test, env)
+            return self._eval(node.body, env) | self._eval(node.orelse, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for elt in node.elts:
+                out |= self._eval(elt, env)
+            return out
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if k is not None:
+                    out |= self._eval(k, env)
+            for v in node.values:
+                out |= self._eval(v, env)
+            return out
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            # str() of a device value syncs, but f-strings over scalars
+            # are ubiquitous in logging; deliberately not a sink.
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env)
+            return set()
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            inner = {k: set(v) for k, v in env.items()}
+            for gen in node.generators:
+                origins = self._eval(gen.iter, inner)
+                if origins:
+                    self._event(gen.iter, "iteration", origins)
+                self._bind(gen.target, set(origins), inner)
+            out = set()
+            if isinstance(node, ast.DictComp):
+                out |= self._eval(node.key, inner)
+                out |= self._eval(node.value, inner)
+            else:
+                out |= self._eval(node.elt, inner)
+            return out
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.Yield):
+            if node.value is not None:
+                self.returns |= self._eval(node.value, env)
+            return set()
+        if isinstance(node, ast.Lambda):
+            return set()
+        return set()
+
+    def _eval_call(self, call: ast.Call, env: Dict[str, Set[str]]) -> Set[str]:
+        # Evaluate arguments first (their own calls may be sinks too).
+        arg_origins: List[Set[str]] = [self._eval(a, env) for a in call.args]
+        kw_origins: Dict[str, Set[str]] = {}
+        for kw in call.keywords:
+            o = self._eval(kw.value, env)
+            if kw.arg:
+                kw_origins[kw.arg] = o
+
+        reason = self._direct_sink(call)
+        if reason is not None:
+            hit: Set[str] = set()
+            for o in arg_origins:
+                hit |= o
+            if not hit and isinstance(call.func, ast.Attribute):
+                hit = self._eval(call.func.value, env)
+            if hit:
+                self._event(call, reason, hit)
+            return set()  # result is a host value
+
+        # Tainted arguments crossing into parameters that sink inside
+        # the (resolved) callee.
+        for i, o in enumerate(arg_origins):
+            if not o:
+                continue
+            hit = self.sink_for_arg(call, i)
+            if hit is not None:
+                self._event(call, hit[0], o, through=hit[1])
+        for name, o in kw_origins.items():
+            if not o:
+                continue
+            hit = self.sink_for_arg(call, name)
+            if hit is not None:
+                self._event(call, hit[0], o, through=hit[1])
+
+        out: Set[str] = set()
+        if self.is_source(call):
+            out.add(DEVICE)
+        if self.returns_device(call):
+            out.add(DEVICE)
+        if isinstance(call.func, ast.Attribute):
+            # A method result on a tainted receiver stays tainted
+            # (x.sum(), x.astype(...), x.reshape(...)) unless the
+            # method lands on the host (.item(), .devices(), ...).
+            recv = self._eval(call.func.value, env)
+            if recv and call.func.attr not in SINK_ATTR_CALLS and \
+                    call.func.attr not in HOST_RESULT_METHODS:
+                out |= recv
+        return out
+
+    @staticmethod
+    def _direct_sink(call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in SINK_NAME_CALLS:
+            return SINK_NAME_CALLS[func.id]
+        if isinstance(func, ast.Attribute):
+            if func.attr in SINK_ATTR_CALLS:
+                return SINK_ATTR_CALLS[func.attr]
+            try:
+                txt = ast.unparse(func)
+            except Exception:  # pragma: no cover
+                return None
+            if txt in SINK_DOTTED_CALLS:
+                return SINK_DOTTED_CALLS[txt]
+        return None

@@ -1,0 +1,369 @@
+"""Whole-program substrate for doormanlint: symbol table, import graph,
+and an approximate call graph over the scanned tree.
+
+doormanlint v1 was per-file: every checker saw one ast at a time, so a
+host sync reached through a helper call, a lock-order cycle spanning two
+files, or a module the hand-kept CHAOS_REACHABLE list forgot were all
+invisible. This module gives the checkers the three whole-program
+structures those rules need, still stdlib-only and still without ever
+importing the code under analysis:
+
+  * **symbol table** — every function/method in the tree, keyed by
+    (file, qualname), with per-file import-alias maps;
+  * **import graph** — repo-internal module dependencies, including the
+    Python semantics that importing ``a.b.c`` executes ``a/__init__.py``
+    and ``a/b/__init__.py``; ``reachable_files`` is the derivation that
+    replaces hand-kept module registries (CHAOS_ROOTS below);
+  * **approximate call graph** — call sites resolved best-effort:
+    bare names bind to function-local defs, then module-level defs,
+    then imported symbols; ``self.m()`` binds through the enclosing
+    class (and its same-tree bases); ``alias.f()`` binds through the
+    import-alias map; any other ``obj.m()`` falls back to the
+    unique-method heuristic (resolve only when at most
+    _MAX_METHOD_CANDIDATES classes in the whole tree define ``m`` and
+    ``m`` is not a container/stdlib-ish name from _GENERIC_METHODS).
+
+The call graph is deliberately approximate in the sound-enough-to-lint
+sense: unresolved calls resolve to nothing (findings can be missed
+through them, never invented), and the unique-method fallback is capped
+so dict-shaped method names don't weld the graph into one blob.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import FileContext, qualname
+
+# Roots of the seeded-determinism derivation: what the chaos runner, the
+# serving stack it drives, and the sim kernel (the other seeded-replay
+# surface) can execute. Everything transitively imported from these is
+# chaos-reachable; nothing else is. doc/lint.md "Registry derivation".
+CHAOS_ROOTS = (
+    "doorman_tpu/chaos/",
+    "doorman_tpu/server/",
+    "doorman_tpu/sim/",
+)
+
+# Attribute calls resolved through the unique-method fallback only when
+# the bare name is not one of these: container/protocol names that a
+# dozen unrelated classes (and every dict/list/set) share would weld
+# the call graph into one component.
+_GENERIC_METHODS = frozenset({
+    "get", "put", "pop", "add", "append", "extend", "update", "items",
+    "keys", "values", "copy", "clear", "remove", "discard", "insert",
+    "close", "open", "read", "write", "flush", "start", "stop", "run",
+    "join", "send", "recv", "acquire", "release", "wait", "notify",
+    "set", "reset", "result", "submit", "cancel", "done", "record",
+    "lap", "span", "instant", "observe", "info", "debug", "warning",
+    "error", "exception", "encode", "decode", "format", "strip",
+    "split", "sort", "index", "count", "next", "name", "status",
+    "snapshot", "to_json", "from_json",
+})
+_MAX_METHOD_CANDIDATES = 3
+
+
+class FunctionInfo:
+    """One def in the tree: identity, location, and its call sites."""
+
+    __slots__ = ("ctx", "node", "qualname", "key", "cls", "calls")
+
+    def __init__(self, ctx: FileContext, node: ast.AST, qn: str,
+                 cls: Optional[str]):
+        self.ctx = ctx
+        self.node = node
+        self.qualname = qn
+        self.key = (ctx.relpath, qn)
+        self.cls = cls  # immediately-enclosing class name, if a method
+        # Calls lexically inside this def but NOT inside a nested def
+        # (those belong to the nested FunctionInfo): list of
+        # (ast.Call, resolved targets tuple).
+        self.calls: List[Tuple[ast.Call, Tuple["FunctionInfo", ...]]] = []
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<fn {self.key[0]}::{self.key[1]}>"
+
+
+def _dotted(relpath: str) -> str:
+    """Module dotted name of a repo-relative path."""
+    mod = relpath[:-3].replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _ancestor_inits(relpath: str) -> List[str]:
+    """Package __init__.py files that importing this module executes."""
+    out = []
+    parts = relpath.split("/")
+    for i in range(1, len(parts)):
+        out.append("/".join(parts[:i]) + "/__init__.py")
+    return out
+
+
+class RepoGraph:
+    """Symbol table + import graph + approximate call graph (module
+    docstring). Built once per lint run from the already-parsed
+    FileContexts; all lookups afterwards are dict hits."""
+
+    def __init__(self, files: Sequence[FileContext]):
+        self.files = list(files)
+        self.by_path: Dict[str, FileContext] = {f.relpath: f for f in files}
+        self.module_of: Dict[str, str] = {}   # dotted -> relpath
+        for f in files:
+            self.module_of[_dotted(f.relpath)] = f.relpath
+
+        # relpath -> set of repo-internal relpaths it imports.
+        self.imports: Dict[str, Set[str]] = {}
+        # relpath -> local name -> ("module", relpath) |
+        #                          ("symbol", relpath, symbol)
+        self.aliases: Dict[str, Dict[str, tuple]] = {}
+        for f in files:
+            self._scan_imports(f)
+
+        # Symbol table.
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        # (relpath, class, method) -> FunctionInfo
+        self._methods: Dict[Tuple[str, str, str], FunctionInfo] = {}
+        # (relpath, name) -> module-level FunctionInfo
+        self._module_fns: Dict[Tuple[str, str], FunctionInfo] = {}
+        # bare method name -> [FunctionInfo] (unique-method fallback)
+        self._methods_by_name: Dict[str, List[FunctionInfo]] = defaultdict(list)
+        # class name -> [(relpath, ClassDef)]
+        self.classes: Dict[str, List[Tuple[str, ast.ClassDef]]] = defaultdict(list)
+        for f in files:
+            self._scan_defs(f)
+        for f in files:
+            self._scan_calls(f)
+
+        # Reverse adjacency: callee key -> [(caller, call node)].
+        self.callers: Dict[Tuple[str, str], List[Tuple[FunctionInfo, ast.Call]]]
+        self.callers = defaultdict(list)
+        for fn in self.functions.values():
+            for call, targets in fn.calls:
+                for t in targets:
+                    self.callers[t.key].append((fn, call))
+
+    # -- import graph ---------------------------------------------------
+
+    def _scan_imports(self, ctx: FileContext) -> None:
+        deps: Set[str] = set()
+        alias: Dict[str, tuple] = {}
+        # Base package for level-1 relative imports: the module's own
+        # package — which is the module itself for an __init__.py.
+        pkg = _dotted(ctx.relpath)
+        if not ctx.relpath.endswith("__init__.py"):
+            pkg = pkg.rpartition(".")[0]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    rel = self._module_rel(a.name)
+                    if rel:
+                        deps.add(rel)
+                        alias[a.asname or a.name.split(".")[0]] = (
+                            ("module", rel) if a.asname
+                            else ("module", self._module_rel(a.name.split(".")[0]) or rel)
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = pkg.rsplit(".", node.level - 1)[0] if node.level > 1 else pkg
+                    base = f"{up}.{base}" if base else up
+                base_rel = self._module_rel(base)
+                for a in node.names:
+                    sub_rel = self._module_rel(f"{base}.{a.name}")
+                    local = a.asname or a.name
+                    if sub_rel:  # `from pkg import submodule`
+                        deps.add(sub_rel)
+                        alias[local] = ("module", sub_rel)
+                    elif base_rel:  # `from module import symbol`
+                        deps.add(base_rel)
+                        alias[local] = ("symbol", base_rel, a.name)
+        # Importing a.b.c executes a/__init__.py and a/b/__init__.py.
+        for dep in list(deps):
+            for init in _ancestor_inits(dep):
+                if init in self.by_path:
+                    deps.add(init)
+        deps.discard(ctx.relpath)
+        self.imports[ctx.relpath] = deps
+        self.aliases[ctx.relpath] = alias
+
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        return self.module_of.get(dotted)
+
+    def reachable_files(self, root_prefixes: Iterable[str]) -> Set[str]:
+        """Transitive import closure from every file under the given
+        repo-relative prefixes (the roots are included)."""
+        prefixes = tuple(root_prefixes)
+        seen: Set[str] = set()
+        stack = [p for p in self.by_path if p.startswith(prefixes)]
+        while stack:
+            rel = stack.pop()
+            if rel in seen:
+                continue
+            seen.add(rel)
+            stack.extend(self.imports.get(rel, ()))
+        return seen
+
+    def chaos_reachable(self) -> Set[str]:
+        """The derived replacement for the old hand-kept CHAOS_REACHABLE
+        prefix list (see CHAOS_ROOTS)."""
+        return self.reachable_files(CHAOS_ROOTS)
+
+    # -- symbol table ---------------------------------------------------
+
+    def _scan_defs(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name].append((ctx.relpath, node))
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qn = qualname(ctx, node)
+            parent = ctx.parents.get(node)
+            cls = parent.name if isinstance(parent, ast.ClassDef) else None
+            info = FunctionInfo(ctx, node, qn, cls)
+            self.functions[info.key] = info
+            if cls is not None:
+                self._methods[(ctx.relpath, cls, node.name)] = info
+                self._methods_by_name[node.name].append(info)
+            elif isinstance(parent, ast.Module):
+                self._module_fns[(ctx.relpath, node.name)] = info
+
+    def function_at(self, relpath: str, qn: str) -> Optional[FunctionInfo]:
+        return self.functions.get((relpath, qn))
+
+    def method(self, relpath: str, cls: str, name: str
+               ) -> Optional[FunctionInfo]:
+        return self._methods.get((relpath, cls, name))
+
+    def has_qualname(self, qn: str) -> bool:
+        """Does any file define this Class.method / function?"""
+        return any(key[1] == qn for key in self.functions)
+
+    def enclosing_function(self, ctx: FileContext, node: ast.AST
+                           ) -> Optional[FunctionInfo]:
+        """The FunctionInfo whose body (innermost) contains node."""
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.functions.get((ctx.relpath, qualname(ctx, cur)))
+            cur = ctx.parents.get(cur)
+        return None
+
+    # -- call graph -----------------------------------------------------
+
+    def _scan_calls(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = self.enclosing_function(ctx, node)
+            if owner is None:
+                continue  # module-level call: import graph covers it
+            targets = self.resolve_call(ctx, node, owner)
+            owner.calls.append((node, targets))
+
+    def resolve_call(self, ctx: FileContext, call: ast.Call,
+                     owner: FunctionInfo) -> Tuple[FunctionInfo, ...]:
+        func = call.func
+        alias = self.aliases.get(ctx.relpath, {})
+        if isinstance(func, ast.Name):
+            # function-local nested def, then module-level, then import.
+            for n in ast.walk(owner.node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not owner.node and n.name == func.id:
+                    info = self.functions.get((ctx.relpath, qualname(ctx, n)))
+                    if info:
+                        return (info,)
+            info = self._module_fns.get((ctx.relpath, func.id))
+            if info:
+                return (info,)
+            bound = alias.get(func.id)
+            if bound and bound[0] == "symbol":
+                info = self._module_fns.get((bound[1], bound[2]))
+                if info:
+                    return (info,)
+            return ()
+        if not isinstance(func, ast.Attribute):
+            return ()
+        attr = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and owner.cls is not None:
+                info = self._method_in_class(ctx.relpath, owner.cls, attr)
+                if info:
+                    return (info,)
+                return self._fallback(attr)
+            bound = alias.get(recv.id)
+            if bound and bound[0] == "module":
+                info = self._module_fns.get((bound[1], attr))
+                return (info,) if info else ()
+            if recv.id in self.classes:  # ClassName.method(...)
+                for rel, _ in self.classes[recv.id]:
+                    info = self._methods.get((rel, recv.id, attr))
+                    if info:
+                        return (info,)
+                return ()
+            return self._fallback(attr)
+        if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name):
+            # a.b.attr(...): `a` may alias a package (import a.b).
+            bound = alias.get(recv.value.id)
+            if bound and bound[0] == "module":
+                sub = self._module_rel(f"{_dotted(bound[1])}.{recv.attr}")
+                if sub:
+                    info = self._module_fns.get((sub, attr))
+                    return (info,) if info else ()
+        return self._fallback(attr)
+
+    def _method_in_class(self, relpath: str, cls: str, name: str
+                         ) -> Optional[FunctionInfo]:
+        """Method lookup through the class and its same-tree bases."""
+        seen: Set[str] = set()
+        stack = [(relpath, cls)]
+        while stack:
+            rel, cname = stack.pop()
+            if cname in seen:
+                continue
+            seen.add(cname)
+            info = self._methods.get((rel, cname, name))
+            if info:
+                return info
+            for crel, cnode in self.classes.get(cname, ()):
+                if crel != rel:
+                    continue
+                for base in cnode.bases:
+                    if isinstance(base, ast.Name):
+                        for brel, _ in self.classes.get(base.id, ()):
+                            stack.append((brel, base.id))
+        return None
+
+    def _fallback(self, attr: str) -> Tuple[FunctionInfo, ...]:
+        if attr in _GENERIC_METHODS or attr.startswith("__"):
+            return ()
+        cands = self._methods_by_name.get(attr, ())
+        if 0 < len(cands) <= _MAX_METHOD_CANDIDATES:
+            return tuple(cands)
+        return ()
+
+    # -- reachability over calls ---------------------------------------
+
+    def transitive_callees(self, roots: Iterable[FunctionInfo]
+                           ) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        stack = [r.key for r in roots]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            fn = self.functions.get(key)
+            if fn is None:
+                continue
+            for _, targets in fn.calls:
+                stack.extend(t.key for t in targets)
+        return seen
