@@ -1471,6 +1471,316 @@ def bench_server_push_vs_poll() -> None:
     asyncio.run(run())
 
 
+# server_stream_fanout_scaling: the sharded fan-out engine (ISSUE 12).
+# Subscriber tiers with CONSTANT subscribers-per-resource (the churn
+# always touches the same number of affected subscribers), so fan-out
+# wall time per tick measures cost in TOTAL subscribers — the sublinear
+# claim. 100k+ live streams ride the direct registry surface (the
+# fanout is the thing measured; establishment transport is the storm
+# leg's job).
+FANOUT_TIERS = (1_000, 10_000, 100_000)
+FANOUT_SUBS_PER_RESOURCE = 50
+FANOUT_CHURN_RESOURCES = 4
+FANOUT_CHURN_TICKS = 6
+FANOUT_QUIET_TICKS = 3
+FANOUT_TIER_BUDGET_SECONDS = 120.0
+FANOUT_STORM_SECONDS = 30.0
+FANOUT_SHARDS = 4
+
+
+def bench_server_stream_fanout_scaling() -> None:
+    """Fan-out wall time per tick across subscriber tiers, quiet-tick
+    cost, grant-propagation, and the storm driver's held-stream count.
+
+    Per tier: a native-store batch server with a 4-shard stream
+    registry holds N direct WatchCapacity subscriptions (one resource
+    each, N/50 resources so churn always affects ~200 subscribers),
+    then FANOUT_CHURN_TICKS ticks each churn 4 resources — the
+    device matcher extracts the (subscriber, row) pairs and only those
+    decide+serialize. The emitted value is the measured log-log
+    exponent of mean churn-tick fan-out wall time vs subscriber count:
+    < 1.0 is the sublinearity SLO floor (flat is the design point —
+    affected subscribers are constant by construction). Quiet ticks
+    (nothing changed, nothing due) are measured separately and must
+    stay subscriber-count-independent; grant propagation is the full
+    tick wall (the push is enqueued inside the tick edge), p99 held
+    under one tick interval. A tier that cannot establish within its
+    budget degrades the row to the achieved tiers (diagnostic-not-row
+    below two tiers — no scaling claim from one point). The storm leg
+    re-establishes the largest achieved tier's stream count over real
+    loopback gRPC with the multiplexed driver (--streams-per-worker)
+    and reports the streams actually held."""
+    import asyncio
+
+    from doorman_tpu import native as _native
+    from doorman_tpu.algorithms import Request as _Request
+    from doorman_tpu.proto import doorman_stream_pb2 as _spb
+    from doorman_tpu.server.config import parse_yaml_config
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    if not _native.native_available():
+        diagnostic({
+            "diagnostic": "stream_fanout_requires_native",
+            "note": (
+                "the fan-out scaling row measures the delta-tracked "
+                "native path; the python store re-decides every "
+                "subscription per tick (check_all) by design"
+            ),
+        })
+        return
+
+    # Capacity 600 vs 50 subscribers wanting 10: the churner's 500-want
+    # flip moves the row between under- and oversubscription, so every
+    # churned row's subscribers observe a real grant change (10 <-> 6).
+    config = parse_yaml_config(
+        "resources:\n"
+        '- identifier_glob: "*"\n'
+        "  capacity: 600\n"
+        "  safe_capacity: 1\n"
+        "  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 7200,\n"
+        "              refresh_interval: 3600,\n"
+        "              learning_mode_duration: 0}\n"
+    )
+
+    async def make_server():
+        server = CapacityServer(
+            "fanout-bench", TrivialElection(), mode="batch",
+            tick_interval=1.0, minimum_refresh_interval=0.0,
+            native_store=True, stream_push=True,
+            stream_shards=FANOUT_SHARDS, flightrec_capacity=0,
+        )
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(config)
+        await asyncio.sleep(0)  # election callbacks land
+        server.current_master = f"127.0.0.1:{port}"
+        for task in server._tasks:
+            task.cancel()
+        server._tasks.clear()
+        return server, f"127.0.0.1:{port}"
+
+    def drain(subs):
+        n = 0
+        for sub in subs:
+            while not sub.queue.empty():
+                sub.queue.get_nowait()
+                n += 1
+        return n
+
+    async def run_tier(n_subs: int) -> dict:
+        server, _addr = await make_server()
+        try:
+            registry = server._streams
+            n_resources = max(n_subs // FANOUT_SUBS_PER_RESOURCE, 1)
+            by_resource: dict = {}
+            t_start = time.monotonic()
+            for i in range(n_subs):
+                rid = f"r{i % n_resources}"
+                req = _spb.WatchCapacityRequest(client_id=f"s{i}")
+                rr = req.resource.add()
+                rr.resource_id = rid
+                rr.wants = 10.0
+                sub = registry.subscribe(req)
+                server._stream_match_add(sub)
+                by_resource.setdefault(rid, []).append(sub)
+                if (
+                    i % 4096 == 0
+                    and time.monotonic() - t_start
+                    > FANOUT_TIER_BUDGET_SECONDS
+                ):
+                    raise TimeoutError(
+                        f"established {i} of {n_subs} within budget"
+                    )
+            establish_s = time.monotonic() - t_start
+            all_subs = [s for subs in by_resource.values() for s in subs]
+            drain(all_subs)
+            for _ in range(3):  # warm: deliveries converge
+                await server.tick_once()
+                drain(all_subs)
+            registry.take_tick_stats()
+            churn_fanout_s, tick_walls, pushed = [], [], 0
+            for t in range(FANOUT_CHURN_TICKS):
+                churned = [
+                    f"r{(t * FANOUT_CHURN_RESOURCES + j) % n_resources}"
+                    for j in range(FANOUT_CHURN_RESOURCES)
+                ]
+                wants = 500.0 if t % 2 == 0 else 1.0
+                for rid in churned:
+                    server._decide(
+                        rid, _Request("churner", 0.0, wants, 1,
+                                      priority=0)
+                    )
+                t0 = time.monotonic()
+                await server.tick_once()
+                tick_walls.append(time.monotonic() - t0)
+                churn_fanout_s.append(registry.last_fanout_seconds)
+                # Drain everything: grants land one pipelined tick
+                # after their solve, so a row's push can trail its
+                # churn tick (harness cost, outside the fanout lap).
+                pushed += drain(all_subs)
+            # Settle: the last churn's delivery (and its pushes) land
+            # before the quiet window, so quiet ticks are QUIET.
+            for _ in range(2):
+                await server.tick_once()
+                pushed += drain(all_subs)
+            churn_stats = registry.take_tick_stats()
+            quiet_fanout_s = []
+            for _ in range(FANOUT_QUIET_TICKS):
+                await server.tick_once()
+                quiet_fanout_s.append(registry.last_fanout_seconds)
+            stats = registry.take_tick_stats()
+            return {
+                "matched_pairs": churn_stats["matched_pairs"],
+                "churn_subs_walked": churn_stats["subs_walked"],
+                "subscribers": n_subs,
+                "resources": n_resources,
+                "establish_s": round(establish_s, 3),
+                "churn_fanout_ms_mean": round(
+                    1000.0 * sum(churn_fanout_s) / len(churn_fanout_s),
+                    4,
+                ),
+                "quiet_fanout_ms_mean": round(
+                    1000.0 * sum(quiet_fanout_s) / len(quiet_fanout_s),
+                    4,
+                ),
+                "tick_wall_ms_p99": round(
+                    1000.0 * sorted(tick_walls)[-1], 3
+                ),
+                "pushed_messages": pushed,
+                "quiet_subs_walked": stats["subs_walked"],
+            }
+        finally:
+            await server.stop()
+
+    async def run_storm_leg(target: int) -> dict:
+        from doorman_tpu.loadtest.storm import run_storm
+
+        server, addr = await make_server()
+        # The storm leg needs the real tick cadence for pushes.
+        server._tasks.append(
+            asyncio.get_running_loop().create_task(server._tick_loop())
+        )
+        try:
+            workers = 32
+            out = await run_storm(
+                addr, "storm", workers=workers,
+                duration=FANOUT_STORM_SECONDS, bands=(0,), wants=5.0,
+                stream=True, seed=3,
+                streams_per_worker=max(target // workers, 1),
+                resource_spread=max(
+                    target // FANOUT_SUBS_PER_RESOURCE, 1
+                ),
+            )
+            return {
+                "target": target,
+                "held": out["ok"],
+                "pushes": out["pushes"],
+                "errors": out["errors"],
+                "resets": out["resets"],
+            }
+        finally:
+            await server.stop()
+
+    async def run():
+        import math
+
+        from doorman_tpu.obs import slo as slo_mod
+
+        tiers, failures = [], []
+        for n in FANOUT_TIERS:
+            try:
+                tiers.append(await run_tier(n))
+            except (TimeoutError, MemoryError) as exc:
+                failures.append({"subscribers": n, "error": str(exc)})
+                break
+        if len(tiers) < 2:
+            diagnostic({
+                "diagnostic": "stream_fanout_unmeasured",
+                "note": (
+                    "fewer than two subscriber tiers completed; no "
+                    "scaling claim from one point"
+                ),
+                "tiers": tiers,
+                "failures": failures,
+            })
+            return
+        xs = [math.log(t["subscribers"]) for t in tiers]
+        ys = [
+            math.log(max(t["churn_fanout_ms_mean"], 1e-4))
+            for t in tiers
+        ]
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        exponent = round(
+            sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+            / sum((x - mx) ** 2 for x in xs),
+            4,
+        )
+        prop_p99 = max(t["tick_wall_ms_p99"] for t in tiers)
+        quiet_ratio = round(
+            max(t["quiet_fanout_ms_mean"] for t in tiers)
+            / max(min(t["quiet_fanout_ms_mean"] for t in tiers), 1e-4),
+            2,
+        )
+        storm = await run_storm_leg(tiers[-1]["subscribers"])
+        if storm["held"] < storm["target"] * 0.9:
+            diagnostic({
+                "diagnostic": "stream_storm_under_target",
+                "note": (
+                    f"storm driver held {storm['held']} of "
+                    f"{storm['target']} streams within "
+                    f"{FANOUT_STORM_SECONDS:.0f}s on this box"
+                ),
+                "storm": storm,
+            })
+        specs = [
+            slo_mod.SloSpec(
+                name="server_stream_fanout_scaling:sublinear",
+                kind="max", target=1.0, unit="exponent",
+                source={"type": "scalar", "key": "exponent"},
+                description=(
+                    "log-log slope of churn-tick fan-out wall time vs "
+                    "subscriber count"
+                ),
+            ),
+            slo_mod.SloSpec(
+                name="server_stream_fanout_scaling:grant_propagation",
+                kind="max", target=1000.0, unit="ms",
+                source={"type": "scalar", "key": "prop_p99_ms"},
+                description=(
+                    "p99 tick wall (push enqueued inside the tick "
+                    "edge) vs one tick interval"
+                ),
+            ),
+        ]
+        verdicts = slo_mod.SloEngine(specs).evaluate(slo_mod.SloInputs(
+            scalars={"exponent": exponent, "prop_p99_ms": prop_p99}
+        ))
+        emit(
+            {
+                "metric": "server_stream_fanout_scaling",
+                "value": exponent,
+                "unit": "exponent",
+                "stream_shards": FANOUT_SHARDS,
+                "subscribers_max": tiers[-1]["subscribers"],
+                "prop_p99_ms": prop_p99,
+                "quiet_fanout_ms_max": max(
+                    t["quiet_fanout_ms_mean"] for t in tiers
+                ),
+                "quiet_fanout_spread": quiet_ratio,
+                "quiet_subs_walked": max(
+                    t["quiet_subs_walked"] for t in tiers
+                ),
+                "storm_streams_held": storm["held"],
+                "tiers": tiers,
+                "slo": verdicts,
+            },
+            artifact_extra={"failures": failures, "storm": storm},
+        )
+
+    asyncio.run(run())
+
+
 def gate_pallas_kernels() -> None:
     """Real-TPU pallas regression gate: compile and run BOTH pallas
     kernels (dense lanes + banded priority water-fill) on the chip and
@@ -2011,6 +2321,10 @@ if __name__ == "__main__":
         # Streaming lease push vs the polling population (no device
         # work): steady-state RPC reduction + grant propagation.
         bench_server_push_vs_poll()
+        # Sharded fan-out engine: fan-out wall time vs subscriber
+        # count (sublinearity SLO floor), quiet-tick independence, and
+        # the multiplexed storm driver's held-stream count.
+        bench_server_stream_fanout_scaling()
         # Federated root tier: N shards ticking concurrently on their
         # own devices — aggregate leases/sec + scaling_vs_1root.
         bench_server_tick_federated_roots()

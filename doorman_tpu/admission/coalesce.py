@@ -44,7 +44,30 @@ from doorman_tpu.proto import doorman_pb2 as pb
 
 log = logging.getLogger(__name__)
 
-__all__ = ["Coalescer"]
+__all__ = ["Coalescer", "decide_grouped"]
+
+
+def decide_grouped(server, work: List[Tuple[str, Request]]) -> List[tuple]:
+    """The grouped per-resource decision pass, shared by the coalescer's
+    window resolution and the stream fanout's per-shard tick-edge pass.
+
+    `work` is (resource_id, Request) pairs; returns
+    `(lease, resource, safe_capacity)` per pair IN INPUT ORDER, decided
+    grouped by resource with each resource's requests replayed in
+    arrival order — byte-identical to running the same stream through
+    the per-request path (see the module docstring's parity argument:
+    different resources touch disjoint stores, and safe_capacity is
+    computed immediately after each decide, exactly where the
+    per-request path computes it)."""
+    slots: List[tuple] = [None] * len(work)  # type: ignore[list-item]
+    groups: dict = {}
+    for i, (resource_id, request) in enumerate(work):
+        groups.setdefault(resource_id, []).append((i, request))
+    for resource_id, entries in groups.items():
+        for i, request in entries:
+            lease, res = server._decide(resource_id, request)
+            slots[i] = (lease, res, res.safe_capacity())
+    return slots
 
 
 class Coalescer:
@@ -159,24 +182,17 @@ class Coalescer:
         """The grouped decision pass (see module docstring for the
         parity argument). May run on the loop or in the executor."""
         server = self.server
-        slots: List[List] = [
-            [None] * len(req.resource) for req, _ in batch
-        ]
-        groups: dict = {}
-        for bi, (req, _) in enumerate(batch):
-            for ri, rr in enumerate(req.resource):
-                groups.setdefault(rr.resource_id, []).append((bi, ri, rr))
+        work: List[Tuple[str, Request]] = []
+        for req, _ in batch:
+            for rr in req.resource:
+                has = rr.has.capacity if rr.HasField("has") else 0.0
+                work.append((
+                    rr.resource_id,
+                    Request(req.client_id, has, rr.wants, 1,
+                            priority=rr.priority),
+                ))
         try:
-            for resource_id, entries in groups.items():
-                for bi, ri, rr in entries:
-                    req = batch[bi][0]
-                    has = rr.has.capacity if rr.HasField("has") else 0.0
-                    lease, res = server._decide(
-                        resource_id,
-                        Request(req.client_id, has, rr.wants, 1,
-                                priority=rr.priority),
-                    )
-                    slots[bi][ri] = (lease, res.safe_capacity())
+            decided = decide_grouped(server, work)
         except BaseException:
             # A partially-applied window leaves the fused staging cache
             # unable to prove freshness for rows already written (their
@@ -189,11 +205,14 @@ class Coalescer:
         # pack the touched rows NOW — in this RPC window, overlapped
         # with whatever tick is in flight — instead of at the next
         # tick's dispatch (no-op unless the server attached staging).
-        server._fused_stage(groups.keys())
+        server._fused_stage({resource_id for resource_id, _ in work})
         outs = []
-        for (req, _), row in zip(batch, slots):
+        cursor = 0
+        for req, _ in batch:
             out = pb.GetCapacityResponse()
-            for rr, (lease, safe) in zip(req.resource, row):
+            for rr in req.resource:
+                lease, _res, safe = decided[cursor]
+                cursor += 1
                 resp = out.response.add()
                 resp.resource_id = rr.resource_id
                 resp.gets.expiry_time = int(lease.expiry)

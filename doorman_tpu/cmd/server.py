@@ -161,6 +161,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "past it sheds with RESOURCE_EXHAUSTED + "
                         "retry-after so fanout cannot starve the "
                         "tick; 0 = unlimited")
+    p.add_argument("--stream-shards", type=int, default=1,
+                   help="stream push: partition subscribers across "
+                        "this many fanout shards (stable client-id "
+                        "hash), each owning its subs/queues/refresh "
+                        "wheel; the tick-edge decide+serialize passes "
+                        "fan to worker threads when safe. Size to the "
+                        "box's spare cores; 1 = the unsharded "
+                        "reference path (doc/streaming.md)")
     p.add_argument("--shard", default="",
                    help="federated root shard identity as 'i/N' (shard "
                         "i of N): suffixes the election lock with "
@@ -313,6 +321,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         tick_pipeline_depth=args.tick_pipeline_depth,
         stream_push=args.stream_push,
         max_streams_per_band=args.max_streams_per_band,
+        stream_shards=args.stream_shards,
         shard=shard,
     )
 

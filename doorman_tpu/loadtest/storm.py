@@ -21,6 +21,13 @@ and standalone against a real deployment:
 streams (doc/streaming.md): workers subscribe, count pushed deltas,
 and re-establish after sheds/resets/redirects with the same
 retry-after pacing — the storm shape for the per-band stream caps.
+
+``--streams-per-worker M`` multiplexes: each worker task holds M
+streams over ONE shared channel, drained by a single read loop
+(asyncio.wait over the streams' pending reads) — so the driver can
+hold 100k live streams with a few dozen tasks and channels instead of
+one task + channel per stream, which is what lets a single storm
+process exercise the sharded fan-out at its design scale.
 """
 
 from __future__ import annotations
@@ -211,6 +218,210 @@ async def _stream_worker(
                 call.cancel()
 
 
+class _MuxStream:
+    """One multiplexed stream's state inside a mux worker."""
+
+    __slots__ = (
+        "request", "band", "call", "pending", "established", "wake",
+        "t0",
+    )
+
+    def __init__(self, request, band: int):
+        self.request = request
+        self.band = band
+        self.call = None
+        self.pending = None
+        self.established = False
+        self.wake = 0.0  # earliest (re)establishment time
+        self.t0 = 0.0
+
+
+async def _mux_worker(
+    index: int,
+    addr: str,
+    resource: str,
+    bands: tuple,
+    wants: float,
+    deadline: float,
+    stats: Dict,
+    rng: random.Random,
+    honor_retry_after: bool,
+    n_streams: int,
+    resource_spread: int,
+) -> None:
+    """One multiplexed worker: `n_streams` WatchCapacity subscriptions
+    over one shared channel, drained by a single asyncio.wait loop.
+    Stats semantics match _stream_worker (ok = establishments, pushes =
+    received deltas); shed establishments honor retry-after per stream
+    before that stream reconnects."""
+    from doorman_tpu.proto import doorman_stream_pb2 as spb
+
+    def _close(st: "_MuxStream", wake_in: float) -> None:
+        if st.pending is not None:
+            st.pending.cancel()
+            st.pending = None
+        if st.call is not None:
+            st.call.cancel()
+            st.call = None
+        st.established = False
+        st.wake = time.monotonic() + wake_in
+
+    # Establishment ramp: at most this many streams per worker may be
+    # opened-but-not-yet-established at once. Opening every stream in
+    # one burst floods the (often shared, in benches even same-loop)
+    # server with N concurrent establishment decides and nothing
+    # completes; a bounded ramp establishes the population at the rate
+    # the server actually serves.
+    ramp = 64
+
+    async with grpc.aio.insecure_channel(
+        addr, options=(("grpc.use_local_subchannel_pool", 1),)
+    ) as channel:
+        stub = CapacityStub(channel)
+        streams: List[_MuxStream] = []
+        for j in range(n_streams):
+            gi = index * n_streams + j
+            band = bands[gi % len(bands)]
+            request = spb.WatchCapacityRequest(
+                client_id=f"storm-{index}-{j}"
+            )
+            rr = request.resource.add()
+            # resource_spread > 1 fans subscriptions over a resource
+            # family: with everyone on ONE row, every establishment
+            # re-grants every prior subscriber (O(n^2) push traffic),
+            # which measures the resource's popularity, not the
+            # driver's capacity to hold streams.
+            rr.resource_id = (
+                resource if resource_spread <= 1
+                else f"{resource}-{gi % resource_spread}"
+            )
+            rr.wants = wants
+            rr.priority = band
+            streams.append(_MuxStream(request, band))
+        # Completion-queue read loop: every stream's pending read pushes
+        # itself onto done_q when it resolves, so handling a completion
+        # is O(1) in held streams. (asyncio.wait over the pending set
+        # would re-register O(held) callbacks per wake — quadratic at
+        # 100k streams; this is the whole trick that lets one task
+        # drain thousands of streams.)
+        done_q: "asyncio.Queue" = asyncio.Queue()
+
+        def start_read(st: "_MuxStream") -> None:
+            st.pending = asyncio.ensure_future(st.call.read())
+            st.pending.add_done_callback(
+                lambda fut, st=st: done_q.put_nowait((fut, st))
+            )
+
+        from collections import deque
+
+        unopened = deque(streams)
+        waking: List[_MuxStream] = []  # closed, waiting out retry-after
+        opening = 0  # opened but not yet established
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    return
+                if waking:
+                    still = []
+                    for st in waking:
+                        if st.wake <= now:
+                            unopened.append(st)
+                        else:
+                            still.append(st)
+                    waking[:] = still
+                while unopened and opening < ramp:
+                    st = unopened.popleft()
+                    st.t0 = time.monotonic()
+                    st.call = stub.WatchCapacity(st.request)
+                    start_read(st)
+                    opening += 1
+                timeout = deadline - now
+                if waking:
+                    timeout = min(
+                        timeout,
+                        max(min(s.wake for s in waking) - now, 0.0),
+                    )
+                try:
+                    fut, st = await asyncio.wait_for(
+                        done_q.get(), timeout=max(timeout, 0.01)
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                if st.pending is not fut:
+                    continue  # stale: the stream was closed since
+                st.pending = None
+                if not st.established:
+                    # Whatever this read produced — first message,
+                    # shed, error — the stream leaves the
+                    # establishment ramp window.
+                    opening -= 1
+                try:
+                    msg = fut.result()
+                except asyncio.CancelledError:
+                    continue
+                except grpc.aio.AioRpcError as e:
+                    if (
+                        e.code()
+                        == grpc.StatusCode.RESOURCE_EXHAUSTED
+                    ):
+                        stats["shed"] += 1
+                        stats["shed_by_band"][st.band] = (
+                            stats["shed_by_band"].get(st.band, 0) + 1
+                        )
+                        hint = (
+                            (_retry_after(e) or 1.0)
+                            if honor_retry_after else 0.0
+                        )
+                        _close(
+                            st,
+                            0.5 * hint + rng.uniform(0, 0.5 * hint),
+                        )
+                    else:
+                        stats["errors"] += 1
+                        _close(st, 0.2)
+                    waking.append(st)
+                    continue
+                except Exception:
+                    stats["errors"] += 1
+                    _close(st, 0.2)
+                    waking.append(st)
+                    continue
+                if msg is grpc.aio.EOF:
+                    stats["resets"] += 1
+                    _close(st, 0.0)
+                    waking.append(st)
+                    continue
+                if msg.HasField("mastership"):
+                    stats["redirects"] += 1
+                    _close(st, 0.0)
+                    waking.append(st)
+                    continue
+                if not st.established:
+                    st.established = True
+                    stats["ok"] += 1
+                    stats["ok_by_band"][st.band] = (
+                        stats["ok_by_band"].get(st.band, 0) + 1
+                    )
+                    latency = time.monotonic() - st.t0
+                    stats["latencies"].append(latency)
+                    stats["latencies_by_band"].setdefault(
+                        st.band, []
+                    ).append(latency)
+                stats["pushes"] += 1
+                st.request.resume_seq = max(
+                    st.request.resume_seq, int(msg.seq)
+                )
+                mine = st.request.resource[0]
+                for row in msg.response:
+                    if row.resource_id == mine.resource_id:
+                        mine.has.CopyFrom(row.gets)
+                start_read(st)
+        finally:
+            for st in streams:
+                _close(st, 0.0)
+
+
 async def run_storm(
     addr: str,
     resource: str = "storm",
@@ -223,6 +434,8 @@ async def run_storm(
     rpc_timeout: Optional[float] = None,
     seed: int = 0,
     stream: bool = False,
+    streams_per_worker: int = 1,
+    resource_spread: int = 1,
 ) -> Dict:
     """Drive `workers` closed-loop GetCapacity clients (round-robin
     over `bands`) for `duration` seconds; returns aggregate stats with
@@ -242,7 +455,16 @@ async def run_storm(
     rng = random.Random(seed)
     deadline = time.monotonic() + duration
     start = time.monotonic()
-    if stream:
+    if stream and streams_per_worker > 1:
+        await asyncio.gather(*(
+            _mux_worker(
+                i, addr, resource, bands, wants, deadline, stats,
+                random.Random(rng.random()), honor_retry_after,
+                streams_per_worker, resource_spread,
+            )
+            for i in range(workers)
+        ))
+    elif stream:
         await asyncio.gather(*(
             _stream_worker(
                 i, addr, resource, bands[i % len(bands)], wants,
@@ -313,6 +535,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "closed-loop polls; shed establishments honor "
                         "retry-after before reconnecting "
                         "(doc/streaming.md)")
+    p.add_argument("--streams-per-worker", type=int, default=1,
+                   help="stream mode: multiplex this many streams per "
+                        "worker over one shared channel (100k streams "
+                        "without 100k tasks/channels)")
+    p.add_argument("--resource-spread", type=int, default=1,
+                   help="multiplexed stream mode: fan subscriptions "
+                        "over this many resources (<resource>-<k>) so "
+                        "held-stream capacity is measured instead of "
+                        "one row's O(n^2) re-grant traffic")
     return p
 
 
@@ -330,6 +561,8 @@ def main(argv=None) -> None:
         honor_retry_after=not args.ignore_retry_after,
         rpc_timeout=args.rpc_timeout or None,
         stream=args.stream,
+        streams_per_worker=args.streams_per_worker,
+        resource_spread=args.resource_spread,
     ))
     import json
 

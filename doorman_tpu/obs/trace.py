@@ -86,6 +86,7 @@ KNOWN_SPAN_NAMES = frozenset({
     "persist.snapshot",
     "persist.restore",
     "stream.fanout",  # tick-edge lease push (server/streams.py)
+    "stream.shard",  # one shard's slice of the fanout (StreamShard)
     # Federated capacity tree (doorman_tpu/federation): the straddle
     # reconciliation beat and the intermediate's device aggregation
     # tick; federation.* admits computed suffixes.

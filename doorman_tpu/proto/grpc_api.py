@@ -34,6 +34,17 @@ _STREAM_METHODS = {
 }
 
 
+def _stream_response_serializer(msg) -> bytes:
+    """Stream responses may arrive pre-serialized: the fanout assembles
+    push messages as bytes (serialized header + framed row chunks, each
+    hot row serialized once per shard per tick — server/streams.py) and
+    they go on the wire as-is; message objects (terminal redirects, the
+    chaos proxy's forwarded pushes) serialize normally."""
+    if isinstance(msg, (bytes, bytearray, memoryview)):
+        return bytes(msg)
+    return msg.SerializeToString()
+
+
 class CapacityStub:
     """Client-side stub; `channel` may be a sync or aio grpc channel."""
 
@@ -98,7 +109,7 @@ def add_capacity_servicer(server, servicer: CapacityServicer) -> None:
         handlers[name] = grpc.unary_stream_rpc_method_handler(
             getattr(servicer, name),
             request_deserializer=req_cls.FromString,
-            response_serializer=resp_cls.SerializeToString,
+            response_serializer=_stream_response_serializer,
         )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)

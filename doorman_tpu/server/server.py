@@ -111,6 +111,7 @@ class CapacityServer(CapacityServicer):
         tick_pipeline_depth: int = 1,
         stream_push: bool = False,
         max_streams_per_band: int = 0,
+        stream_shards: int = 1,
         shard: Optional[int] = None,
     ):
         if mode not in ("immediate", "batch"):
@@ -255,7 +256,8 @@ class CapacityServer(CapacityServicer):
             from doorman_tpu.server.streams import StreamRegistry
 
             self._streams = StreamRegistry(
-                self, max_streams_per_band=max_streams_per_band
+                self, max_streams_per_band=max_streams_per_band,
+                shards=stream_shards,
             )
         # Delta bookkeeping for the fanout: ticks whose changes have no
         # tracked source (python store, overflow fallback, wide/priority
@@ -266,6 +268,20 @@ class CapacityServer(CapacityServicer):
         self._stream_epoch_seen = -1
         self._rid_map_key = None
         self._rid_map: Dict[int, str] = {}
+        # Device-side changed-row -> subscriber matching
+        # (server/match.py): the incidence structure, the slot ->
+        # subscription map, and the membership key the bindings were
+        # last synced against. Native-store batch servers only (the
+        # python-store fanout is check_all every tick anyway).
+        self._stream_matcher = None
+        self._stream_slots: Dict[int, object] = {}
+        self._stream_match_key = None
+        # Resources currently in learning mode: their scalar decisions
+        # move without store deliveries, so they ride the changed set
+        # every tick. Rebuilt on membership/epoch moves, pruned as
+        # learning windows lapse.
+        self._stream_learning_key = None
+        self._stream_learning: set = set()
 
         # Per-tick flight recorder (doorman_tpu.obs.flightrec): one
         # structured record per tick_once, auto-dumped on an unhandled
@@ -361,6 +377,8 @@ class CapacityServer(CapacityServicer):
 
     async def stop(self) -> None:
         self._stop_profiler()
+        if self._streams is not None:
+            self._streams.close()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -485,6 +503,13 @@ class CapacityServer(CapacityServicer):
         self._resident_ok_key = None
         self._stream_check_all = True
         self._rid_map_key = None
+        # The matcher's rid bindings belong to the replaced engine; the
+        # terminated subs unbind lazily (their slots die with the map).
+        self._stream_matcher = None
+        self._stream_slots = {}
+        self._stream_match_key = None
+        self._stream_learning_key = None
+        self._stream_learning = set()
         self.last_restore = None
         if is_master and self._persist is not None and self.config is not None:
             # Warm takeover: rebuild the just-wiped state from the
@@ -995,37 +1020,45 @@ class CapacityServer(CapacityServicer):
                 self.push_streams()
 
     def push_streams(self) -> None:
-        """One tick-edge stream fanout: hand the registry the resource
-        ids whose grants moved (or check_all when no tracked delta
-        source covered this tick) and let it push deltas. Driven by
-        tick_once (batch mode), the _stream_loop beat (immediate mode),
-        or a stepped harness (the chaos runner). Runs on the event
-        loop; must never take down the tick — fanout trouble logs."""
+        """One tick-edge stream fanout: intersect the engine's changed
+        rids with the device-resident subscription incidence
+        (server/match.py) and hand the registry exactly the matched
+        (subscription, row) work — or check_all when no tracked delta
+        source covered this tick. Driven by tick_once (batch mode), the
+        _stream_loop beat (immediate mode), or a stepped harness (the
+        chaos runner). Runs on the event loop; must never take down the
+        tick — fanout trouble logs."""
         if self._streams is None or not self.is_master:
             return
         if not len(self._streams):
             # Still drain the delta set so stale rids cannot flood the
             # first subscriber's tick.
-            self._stream_changed()
+            self._stream_changed_rids()
             return
         tracer = trace_mod.default_tracer()
         try:
             with tracer.span(
                 "stream.fanout", cat="server",
                 args={"server": self.id,
-                      "subscribers": len(self._streams)},
+                      "subscribers": len(self._streams),
+                      "shards": len(self._streams.shards)},
             ):
-                changed, check_all = self._stream_changed()
-                self._streams.on_tick(changed, check_all)
+                changed_rids, check_all = self._stream_changed_rids()
+                if check_all:
+                    self._streams.on_tick(None, True)
+                else:
+                    matched = self._stream_match(changed_rids)
+                    self._streams.on_tick(None, False, matched=matched)
         except Exception:
             log.exception("%s: stream fanout failed", self.id)
 
-    def _stream_changed(self):
-        """(changed_ids, check_all) for this fanout: the delta-tracked
-        engine's changed rids mapped to resource ids, plus the rows
-        forced by untracked solver parts; check_all when anything made
-        the filter unsound (config epoch move, fallback tick, python
-        store, restore)."""
+    def _stream_changed_rids(self):
+        """(changed_rids, check_all) for this fanout: the delta-tracked
+        engine's changed rids, plus the rows forced by untracked solver
+        parts and the resources in learning mode (their scalar
+        decisions move without store deliveries); check_all when
+        anything made the filter unsound (config epoch move, fallback
+        tick, python store, restore)."""
         check_all = self._stream_check_all or self.mode != "batch"
         self._stream_check_all = False
         if self._config_epoch != self._stream_epoch_seen:
@@ -1036,16 +1069,120 @@ class CapacityServer(CapacityServicer):
         solver = self._resident
         if solver is None or not solver.delta_tracking:
             return None, True
-        changed: set = set()
-        rid_map = self._rid_resource_map()
-        for rid in solver.take_changed_rids():
-            resource_id = rid_map.get(rid)
-            if resource_id is not None:
-                changed.add(resource_id)
+        rids = set(solver.take_changed_rids())
         if check_all:
             return None, True
-        changed |= self._stream_force_ids
-        return changed, False
+        for resource_id in (
+            self._stream_force_ids | self._stream_learning_ids()
+        ):
+            res = self.resources.get(resource_id)
+            if res is not None and hasattr(res.store, "_rid"):
+                rids.add(res.store._rid)
+        return rids, False
+
+    def _stream_learning_ids(self) -> set:
+        """Resource ids currently in learning mode. Rebuilt by one
+        O(#resources) scan only when membership or config moved (the
+        same cadence _rid_resource_map recomputes); otherwise only the
+        current members are re-checked, so a quiet steady-state tick
+        pays O(|learning|) — zero once every window lapsed."""
+        key = (self._config_epoch, len(self.resources))
+        if key != self._stream_learning_key:
+            self._stream_learning_key = key
+            self._stream_learning = {
+                rid for rid, res in self.resources.items()
+                if res.in_learning_mode
+            }
+        elif self._stream_learning:
+            self._stream_learning = {
+                rid for rid in self._stream_learning
+                if (res := self.resources.get(rid)) is not None
+                and res.in_learning_mode
+            }
+        return self._stream_learning
+
+    def _stream_match(self, changed_rids) -> dict:
+        """Matched fanout work for one tick edge: subscription ->
+        exactly the changed resource ids it watches, via the device
+        matcher. Rows per subscription come back in the subscription's
+        line order, so push bytes are independent of match order."""
+        matcher = self._stream_matcher_sync()
+        if not changed_rids or matcher is None or not len(matcher):
+            return {}
+        pairs = matcher.match(sorted(changed_rids))
+        if not len(pairs):
+            return {}
+        rid_map = self._rid_resource_map()
+        hit: Dict[object, set] = {}
+        for slot, rid in pairs:
+            sub = self._stream_slots.get(int(slot))
+            resource_id = rid_map.get(int(rid))
+            if sub is not None and resource_id is not None:
+                hit.setdefault(sub, set()).add(resource_id)
+        return {
+            sub: [r for r in sub.lines if r in rows]
+            for sub, rows in hit.items()
+        }
+
+    def _stream_matcher_sync(self):
+        """The subscription matcher, its bindings synced against the
+        resource-membership key: a sweep removal or config reload can
+        remap engine rids, so the incidence rebuilds from the live
+        subscriptions whenever the key moves (steady state: never —
+        subscribe/unsubscribe update it incrementally)."""
+        if not self._native_store:
+            return None
+        key = (self._config_epoch, len(self.resources))
+        if self._stream_matcher is not None and key == self._stream_match_key:
+            return self._stream_matcher
+        from doorman_tpu.server.match import SubscriptionMatcher
+
+        matcher = SubscriptionMatcher()
+        slots: Dict[int, object] = {}
+        for sub in self._streams.iter_subs():
+            if sub.terminated:
+                continue
+            slot = matcher.add(self._stream_sub_rids(sub))
+            slots[slot] = sub
+            sub.match_slot = slot
+        self._stream_matcher = matcher
+        self._stream_slots = slots
+        self._stream_match_key = (self._config_epoch, len(self.resources))
+        return matcher
+
+    def _stream_sub_rids(self, sub) -> list:
+        """Engine rids of one subscription's lines (creating any
+        resource a sweep removed — its next decide would anyway)."""
+        return [
+            self.get_or_create_resource(resource_id).store._rid
+            for resource_id in sub.lines
+        ]
+
+    def _stream_match_add(self, sub) -> None:
+        """Establishment hook: bind the new subscription into the live
+        incidence structure (point scatters; no rebuild)."""
+        matcher = self._stream_matcher
+        if matcher is None or not self._native_store:
+            return
+        slot = matcher.add(self._stream_sub_rids(sub))
+        self._stream_slots[slot] = sub
+        sub.match_slot = slot
+        # Establishment may have created resources; keep the sync key
+        # current so the incremental bind is not immediately rebuilt.
+        self._stream_match_key = (self._config_epoch, len(self.resources))
+
+    def _stream_match_remove(self, sub) -> None:
+        """Stream-close hook: drop the subscription's incidence rows
+        (idempotent; a matcher rebuilt since establishment reassigned
+        slots, so only a still-current binding is removed)."""
+        matcher = self._stream_matcher
+        slot = sub.match_slot
+        sub.match_slot = None
+        if matcher is None or slot is None:
+            return
+        if self._stream_slots.get(slot) is sub:
+            matcher.remove(slot)
+            self._stream_slots.pop(slot, None)
 
     def _rid_resource_map(self) -> Dict[int, str]:
         """Engine rid -> resource id (native stores only), cached like
@@ -1134,6 +1271,13 @@ class CapacityServer(CapacityServicer):
             rec["subscribers"] = st["subscribers"]
             rec["deltas_pushed"] = st["deltas_pushed"]
             rec["push_bytes"] = st["push_bytes"]
+            # Sharded-fanout shape of this tick: how many shards fanned
+            # out, the (subscription, row) pairs the device matcher
+            # extracted, and the bytes actually serialized (vs pushed —
+            # the gap is the shared-row serialization win).
+            rec["stream_shards"] = st["stream_shards"]
+            rec["matched_pairs"] = st["matched_pairs"]
+            rec["serialized_bytes"] = st["serialized_bytes"]
         if self._admission is not None:
             admitted = 0
             shed_by_band: Dict[str, int] = {}
@@ -1388,6 +1532,9 @@ class CapacityServer(CapacityServicer):
                         grpc.StatusCode.RESOURCE_EXHAUSTED, shed.reason
                     )
                 sub = self._streams.subscribe(request)
+                # Bind the new stream into the device matcher's
+                # incidence structure (a point scatter, not a rebuild).
+                self._stream_match_add(sub)
                 err = False
         finally:
             dur = self._clock() - start
@@ -1402,10 +1549,14 @@ class CapacityServer(CapacityServicer):
             while True:
                 out = await sub.queue.get()
                 yield out
-                if out.HasField("mastership"):
+                # Data pushes are pre-serialized bytes (the stream
+                # serializer passes them through); a message OBJECT is
+                # the terminal mastership redirect.
+                if not isinstance(out, (bytes, bytearray)):
                     return
         finally:
             self._streams.unsubscribe(sub)
+            self._stream_match_remove(sub)
 
     async def GetServerCapacity(self, request, context):
         start = self._clock()
@@ -1863,6 +2014,11 @@ class CapacityServer(CapacityServicer):
         if self._solver is not None:
             for k, v in self._solver.phase_s.items():
                 out[f"batch.{k}"] = v
+        if self._stream_matcher is not None:
+            # The stream fanout's match/staging laps (server/match.py).
+            for k, v in self._stream_matcher.phase_s.items():
+                if v:
+                    out[f"stream.{k}"] = v
         return out
 
     def _last_tick_seconds(self) -> float:
@@ -1911,6 +2067,14 @@ FUSED_TRACKED_WRITERS = frozenset({
     # whole cache on a partially-applied window. (It calls both hooks
     # inline, so it self-certifies; listed for documentation.)
     "Coalescer._decide_batch",
+    # The shared grouped-decide core (admission/coalesce.decide_grouped)
+    # only dispatches to _decide; its CALLERS own the contract exactly
+    # like _decide's own call sites below: Coalescer._decide_batch
+    # re-stages via _fused_stage after the window's writes, and the
+    # stream fanout's per-shard pass (StreamShard.fanout_build) runs
+    # only steady-state refresh decides — identical wants rewritten,
+    # packed bytes unchanged, the StreamRegistry._decide argument.
+    "decide_grouped",
     # _decide writes one row per call; its four call sites own the
     # contract: Coalescer._decide_batch re-stages after the window's
     # writes, _get_server_capacity invalidates after the band loop,

@@ -74,9 +74,13 @@ __all__ = [
 # intermediate's band-masked subtree summation (the launch half of its
 # device tick, federation/aggregate.py) — its own name because it is a
 # different executable than "solve", not a lease solve at all.
+# "match" is the stream fanout's device-side changed-row -> subscriber
+# intersection (server/match.py): the incidence staging scatters plus
+# the masked-gather launch; the matched-pair landing rides "download"
+# like any delivery byte.
 PHASES = (
     "sweep", "drain", "config", "pack", "staging", "upload", "solve",
-    "aggregate", "download", "apply", "delta", "rebuild",
+    "aggregate", "match", "download", "apply", "delta", "rebuild",
 )
 
 

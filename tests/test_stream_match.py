@@ -1,0 +1,136 @@
+"""SubscriptionMatcher (server/match.py): the device-side changed-row
+-> subscriber intersection behind the stream fanout.
+
+Pins: host-mirror and device paths return identical pair sets; the
+incremental scatter path (subscribe/unsubscribe churn within extent
+headroom) matches a from-scratch rebuild; extent overflow repacks;
+slots recycle; and the per-match device work never syncs a shape
+(match size is host-known from the mirrored extent lengths).
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.server.match import SubscriptionMatcher
+
+
+def pairs_set(pairs):
+    return {(int(a), int(b)) for a, b in pairs}
+
+
+def expected(matcher, changed):
+    out = set()
+    for rid in changed:
+        for slot in matcher._members.get(rid, ()):
+            out.add((slot, rid))
+    return out
+
+
+def test_host_mirror_matching():
+    m = SubscriptionMatcher(use_device=False)
+    s0 = m.add([1, 2, 3])
+    s1 = m.add([2])
+    s2 = m.add([3, 7])
+    assert pairs_set(m.match([2])) == {(s0, 2), (s1, 2)}
+    assert pairs_set(m.match([7])) == {(s2, 7)}
+    assert pairs_set(m.match([1, 3])) == {(s0, 1), (s0, 3), (s2, 3)}
+    assert len(m.match([99])) == 0
+    assert m.watchers(2) == 2
+    m.remove(s1)
+    assert pairs_set(m.match([2])) == {(s0, 2)}
+    # Slot recycling: the freed slot is reused.
+    s3 = m.add([2])
+    assert s3 == s1
+    assert pairs_set(m.match([2])) == {(s0, 2), (s3, 2)}
+
+
+def test_remove_is_idempotent_and_unsubscribes_all_rows():
+    m = SubscriptionMatcher(use_device=False)
+    s0 = m.add([4, 5])
+    m.add([5])
+    m.remove(s0)
+    m.remove(s0)
+    assert pairs_set(m.match([4, 5])) == {(1, 5)}
+    assert m.watchers(4) == 0
+
+
+def test_device_matches_host_mirror():
+    """The device path (CSR arrays + masked gather) returns exactly the
+    mirror's pairs, across fresh placement, incremental scatters, and
+    an overflow-forced repack."""
+    dm = SubscriptionMatcher()
+    hm = SubscriptionMatcher(use_device=False)
+    for i in range(12):
+        rids = [i % 5, 5 + (i % 3)]  # every rid in 0..7 populated
+        assert dm.add(rids) == hm.add(rids)
+    changed = [1, 6, 9]  # 9: absent rid
+    got = pairs_set(dm.match(changed))
+    assert got == pairs_set(hm.match(changed))
+    assert got == expected(hm, changed)
+    rebuilds_before = dm.rebuilds
+    # Churn WITHIN extent headroom: incremental scatters, no repack.
+    for slot in (2, 5):
+        dm.remove(slot)
+        hm.remove(slot)
+    s = dm.add([1, 6])
+    assert s == hm.add([1, 6])
+    got = pairs_set(dm.match(changed))
+    assert got == pairs_set(hm.match(changed))
+    assert got == expected(hm, changed)
+    assert dm.rebuilds == rebuilds_before, "headroom churn repacked"
+    assert dm.scatters >= 1, "no incremental scatter happened"
+    # Overflow one row's extent: forces a repack, results unchanged.
+    for _ in range(20):
+        assert dm.add([6]) == hm.add([6])
+    got = pairs_set(dm.match([6]))
+    assert got == pairs_set(hm.match([6]))
+    assert dm.rebuilds > rebuilds_before
+    assert len(got) == dm.watchers(6)
+
+
+def test_match_returns_exact_pairs_no_padding():
+    m = SubscriptionMatcher()
+    for i in range(5):
+        m.add([100 + i])
+    pairs = m.match([100, 103])
+    assert pairs.shape == (2, 2)
+    assert pairs_set(pairs) == {(0, 100), (3, 103)}
+    # Quiet match: zero pairs, zero device work (host-known M == 0).
+    assert m.match([999]).shape == (0, 2)
+
+
+def test_match_phase_laps_recorded():
+    """The "match" PHASES entry laps on the device path (and staging
+    rides the engine's staging vocabulary)."""
+    m = SubscriptionMatcher()
+    m.add([1])
+    m.add([1])
+    assert len(m.match([1])) == 2
+    assert m.phase_s["match"] > 0.0
+    if m.status()["device"]:
+        assert m.phase_s["download"] > 0.0
+
+
+def test_status_shape():
+    m = SubscriptionMatcher(use_device=False)
+    m.add([1, 2])
+    m.match([1])
+    st = m.status()
+    assert st["slots"] == 1 and st["rows"] == 2
+    assert st["matched_total"] == 1
+    assert st["device"] is False
+
+
+@pytest.mark.parametrize("n", [1, 64, 257])
+def test_scales_across_bucket_boundaries(n):
+    """Bucketed shapes (changed-set pad, match cap, packed size) stay
+    correct across their boundaries."""
+    m = SubscriptionMatcher()
+    for i in range(n):
+        m.add([i % 7])
+    changed = list(range(7))
+    pairs = m.match(changed)
+    assert len(pairs) == n
+    assert pairs_set(pairs) == expected(m, changed)
