@@ -409,16 +409,24 @@ def bench_server_tick() -> None:
     alongside). The first tick (rotate=1: every grant delivered) is
     spot-checked against the numpy oracles before any timing.
 
-    Measured twice from identical initial state: once with
-    admission-fused staging (each churn batch plays an admission
-    window — the window that wrote the rows pre-packs them into the
-    solver's staging cache, engine.FusedStaging — emitted as its own
-    `..._fused_wall_ms` row with its own tick-budget SLO verdict), then
-    the round-trip store->drain->pack path as the headline row (the
-    driver parses the LAST line; keeping the headline's semantics
-    unchanged keeps its delta_vs_prev honest across rounds). Both rides
-    the engine seam's compact transfers (bf16-exact wants, int32
-    indices); tests/test_engine.py pins the two paths byte-identical.
+    Measured twice from identical initial state: the round-trip
+    store->drain->pack path first (its metric name and semantics
+    unchanged since r03, so its delta_vs_prev stays honest), then the
+    FUSED pipeline as the headline row (the driver parses the LAST
+    line): fused-tick mode — one packed staged upload, ONE
+    staging->solve->delta launch, one download stream
+    (solver/resident.py fused tails) — plus admission-fused staging
+    (each churn batch plays an admission window that pre-packs the
+    rows it wrote, engine.FusedStaging). The fused row carries the
+    per-tick dispatch accounting (`dispatches_per_tick` /
+    `host_syncs_per_tick` through the utils.dispatch chokepoints, and
+    `dispatch_reduction` vs the round-trip run), its own tick-budget
+    SLO verdict, and the STANDING <10 ms one-chip TPU verdict
+    (obs.slo.tpu_tick_budget_spec — no_data on CPU fallback, pass/fail
+    automatically on the next hardware round). Both variants ride the
+    engine seam's compact transfers (bf16-exact wants, int32 indices);
+    tests/test_engine.py + tests/test_fused_tick.py pin the paths
+    byte-identical.
     """
     import jax
 
@@ -439,7 +447,9 @@ def bench_server_tick() -> None:
     def run(fused: bool) -> dict:
         """One full build + warmup + measured window; a fresh engine
         and rng per variant, so both paths start from byte-identical
-        stores and replay the same churn stream."""
+        stores and replay the same churn stream. `fused` turns on the
+        WHOLE fused pipeline: fused-tick mode (one launch per tick)
+        plus admission-fused staging."""
         rng = np.random.default_rng(11)
         engine = native.StoreEngine()
         kind_choices = np.array(
@@ -492,6 +502,7 @@ def bench_server_tick() -> None:
         solver = ResidentDenseSolver(
             engine, dtype=dtype, device=device,
             rotate_ticks=1,  # first tick delivers all (oracle check)
+            fused=fused,
         )
         if fused:
             solver.attach_staging()
@@ -555,18 +566,22 @@ def bench_server_tick() -> None:
                 # ship — the cache only short-circuits the pack.
                 solver.stage_rids(res_rids[sel])
 
+        from doorman_tpu.utils import dispatch as dispatch_mod
+
         tick_ms = []
         churn_ms = []
         handles = []
         phase_mark = {}
         collects_mark = 0
         fused_windows = fused_rows = 0
+        dispatch_mark = dispatch_mod.snapshot()
         phase_samples = [dict(solver.phase_s)]
         for t in range(n_ticks):
             if t == SERVER_WARMUP:
                 phase_mark = dict(solver.phase_s)
                 collects_mark = solver.ticks
                 fused_windows = fused_rows = 0
+                dispatch_mark = dispatch_mod.snapshot()
             t0 = time.perf_counter()
             churn(t)
             t1 = time.perf_counter()
@@ -583,6 +598,11 @@ def bench_server_tick() -> None:
         for h in handles:
             solver.collect(h)
         drain_ms = (time.perf_counter() - t0) * 1000.0
+        # Per-tick device-dispatch accounting over the measured window
+        # (the same counters the flight recorder stamps per server
+        # tick): the fused-vs-round-trip launch-tax reduction as a
+        # number on the rows below.
+        dispatch_delta = dispatch_mod.delta(dispatch_mark)
         timed = sorted(
             t + drain_ms / n_ticks for t in tick_ms[SERVER_WARMUP:]
         )
@@ -605,43 +625,18 @@ def bench_server_tick() -> None:
             "per_tick": phase_deltas_ms(phase_samples)[SERVER_WARMUP:],
             "fused_windows": fused_windows,
             "fused_rows": fused_rows,
+            "dispatches_per_tick": round(
+                dispatch_delta["dispatches"] / TICKS_SERVER, 3
+            ),
+            "host_syncs_per_tick": round(
+                dispatch_delta["host_syncs"] / TICKS_SERVER, 3
+            ),
         }
 
-    # Fused variant first; the headline (round-trip, semantics
-    # unchanged since r03) stays the LAST emitted line.
-    fused_run = run(fused=True)
-    ftimed = fused_run["timed"]
-    fmed = float(np.median(ftimed))
-    emit(
-        {
-            "metric": "server_tick_1m_leases_native_store_fused_wall_ms",
-            "value": round(fmed, 3),
-            "unit": "ms",
-            "vs_baseline": round(SERVER_TICK_TARGET_MS / fmed, 3),
-            "selection": f"median_of_{TICKS_SERVER}",
-            "best_ms": round(ftimed[0], 3),
-            "p50_ms": round(float(np.percentile(ftimed, 50)), 3),
-            "p90_ms": round(float(np.percentile(ftimed, 90)), 3),
-            "p99_ms": round(float(np.percentile(ftimed, 99)), 3),
-            "pipeline_depth": PIPELINE_DEPTH_SERVER,
-            "rotate_ticks": SERVER_ROTATE_TICKS,
-            # Fused-window depth over the measured window: windows
-            # folded per tick and rows served from the window-time
-            # pack cache (the same tallies the flight recorder stamps
-            # on each server tick as fused_windows/fused_rows).
-            "fused_windows_per_tick": round(
-                fused_run["fused_windows"] / TICKS_SERVER, 3
-            ),
-            "fused_rows_per_tick": round(
-                fused_run["fused_rows"] / TICKS_SERVER, 3
-            ),
-            "phase_ms": fused_run["phases"],
-        },
-        artifact_extra={
-            "phase_ms_per_tick": fused_run["per_tick"],
-        },
-    )
-
+    # Round-trip variant first (metric name + semantics unchanged
+    # since r03, so its trajectory deltas stay honest); the FUSED
+    # pipeline is the headline — the LAST emitted line the driver
+    # parses.
     main_run = run(fused=False)
     timed = main_run["timed"]
     med = float(np.median(timed))
@@ -658,11 +653,74 @@ def bench_server_tick() -> None:
             "p99_ms": round(float(np.percentile(timed, 99)), 3),
             "pipeline_depth": PIPELINE_DEPTH_SERVER,
             "rotate_ticks": SERVER_ROTATE_TICKS,
+            "dispatches_per_tick": main_run["dispatches_per_tick"],
+            "host_syncs_per_tick": main_run["host_syncs_per_tick"],
             "phase_ms": main_run["phases"],
         },
         artifact_extra={
             # Measured window only: one per-phase dict per tick.
             "phase_ms_per_tick": main_run["per_tick"],
+        },
+    )
+
+    fused_run = run(fused=True)
+    ftimed = fused_run["timed"]
+    fmed = float(np.median(ftimed))
+    fp50 = float(np.percentile(ftimed, 50))
+    reduction = (
+        main_run["dispatches_per_tick"]
+        / max(fused_run["dispatches_per_tick"], 1e-9)
+    )
+    fused_row = {
+        "metric": "server_tick_1m_leases_native_store_fused_wall_ms",
+        "value": round(fmed, 3),
+        "unit": "ms",
+        "vs_baseline": round(SERVER_TICK_TARGET_MS / fmed, 3),
+        "selection": f"median_of_{TICKS_SERVER}",
+        "best_ms": round(ftimed[0], 3),
+        "p50_ms": round(fp50, 3),
+        "p90_ms": round(float(np.percentile(ftimed, 90)), 3),
+        "p99_ms": round(float(np.percentile(ftimed, 99)), 3),
+        "pipeline_depth": PIPELINE_DEPTH_SERVER,
+        "rotate_ticks": SERVER_ROTATE_TICKS,
+        # Fused-window depth over the measured window: windows
+        # folded per tick and rows served from the window-time
+        # pack cache (the same tallies the flight recorder stamps
+        # on each server tick as fused_windows/fused_rows).
+        "fused_windows_per_tick": round(
+            fused_run["fused_windows"] / TICKS_SERVER, 3
+        ),
+        "fused_rows_per_tick": round(
+            fused_run["fused_rows"] / TICKS_SERVER, 3
+        ),
+        # The launch-tax numbers: device dispatches + host syncs per
+        # tick through the counted chokepoints, and the reduction the
+        # one-launch fused tick buys vs the round-trip run above
+        # (acceptance floor: >= 3x).
+        "dispatches_per_tick": fused_run["dispatches_per_tick"],
+        "host_syncs_per_tick": fused_run["host_syncs_per_tick"],
+        "dispatch_reduction": round(reduction, 2),
+        "phase_ms": fused_run["phases"],
+    }
+    from doorman_tpu.obs import slo as slo_mod
+
+    verdicts = []
+    budget = slo_mod.bench_verdict(fused_row)
+    if budget is not None:
+        verdicts.append(budget)
+    # The standing one-chip TPU target (<10 ms p50): pass/fail on
+    # accelerator rounds, honest no_data on CPU fallback.
+    verdicts.append(
+        slo_mod.tpu_tick_verdict(
+            fp50, cpu_fallback=bool(_CPU_FALLBACK or
+                                    device.platform == "cpu"),
+        )
+    )
+    fused_row["slo"] = verdicts
+    emit(
+        fused_row,
+        artifact_extra={
+            "phase_ms_per_tick": fused_run["per_tick"],
         },
     )
 

@@ -134,14 +134,28 @@ def make_parser() -> argparse.ArgumentParser:
                         "(byte-identical to the round-trip path; "
                         "needs --admission and --native-store; "
                         "doc/bench.md)")
-    p.add_argument("--tick-pipeline-depth", type=int, default=2,
+    p.add_argument("--fused-tick", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="batch mode: run the resident tick as ONE "
+                        "fused device program — one packed staged "
+                        "upload, one staging->solve->delta launch, one "
+                        "download stream — instead of a dispatch per "
+                        "staged block (byte-identical; "
+                        "--no-fused-tick keeps the round-trip path "
+                        "for baseline measurement and triage, "
+                        "doc/operations.md)")
+    p.add_argument("--tick-pipeline-depth", type=int, default=3,
                    help="batch mode: resident ticks kept in flight — "
                         "tick N's delivery download overlaps the "
                         "staging and solve of ticks N+1..N+depth-1; "
                         "1 is the collect-before-dispatch reference "
                         "pipeline (depth d defers a tick's store "
                         "write-back d-1 ticks, bounded by the "
-                        "delivery rotation's freshness argument)")
+                        "delivery rotation's freshness argument). "
+                        "Default 3: with the fused one-launch tick the "
+                        "download is the dominant async leg, and depth "
+                        "3 keeps a delivery landing while the next "
+                        "tick stages and the one after solves")
     p.add_argument("--admission-max-rps", type=float, default=0.0,
                    help="admission: hard offered-load budget in "
                         "requests/second — arrivals past it shed "
@@ -318,6 +332,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         flightrec_capacity=args.flightrec_buffer,
         flightrec_dir=args.flightrec_dir or None,
         fuse_admission=args.fuse_admission,
+        fused_tick=args.fused_tick,
         tick_pipeline_depth=args.tick_pipeline_depth,
         stream_push=args.stream_push,
         max_streams_per_band=args.max_streams_per_band,

@@ -242,6 +242,15 @@ class DebugServer:
                 )
                 + " (<a href='/debug/slo'>slo</a>)"
             )
+        dispatch = st.get("dispatch")
+        if dispatch:
+            # The fused-tick launch-tax counters (cumulative; per-tick
+            # deltas ride the flight recorder as dispatches/host_syncs).
+            parts.append(
+                f"fused tick: {'on' if st.get('fused_tick') else 'OFF'}"
+                f" | dispatches: {dispatch.get('dispatches', 0)}"
+                f", host syncs: {dispatch.get('host_syncs', 0)}"
+            )
         return f"<p>{' | '.join(parts)}</p>" if parts else ""
 
     def _index_page(self) -> str:

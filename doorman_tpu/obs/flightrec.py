@@ -239,10 +239,14 @@ class FlightRecorder:
                 })
             # straddle_capacity / straddle_updates / upstream_rpcs are
             # the federation beat (server records stamp them per tick
-            # when the server is a shard — doc/federation.md).
+            # when the server is a shard — doc/federation.md);
+            # dispatches / host_syncs are the per-tick dispatch
+            # accounting deltas (utils.dispatch via the server's tick
+            # records) — the fused-tick triage counters.
             for counter in ("admission_level", "persist_seq",
                             "straddle_capacity", "straddle_updates",
-                            "upstream_rpcs"):
+                            "upstream_rpcs", "dispatches",
+                            "host_syncs"):
                 v = rec.get(counter)
                 if isinstance(v, (int, float)):
                     events.append({

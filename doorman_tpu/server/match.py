@@ -42,6 +42,7 @@ import numpy as np
 
 from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.solver.engine import PHASES, ceil_to
+from doorman_tpu.utils import dispatch as dispatch_mod
 
 log = logging.getLogger(__name__)
 
@@ -212,7 +213,11 @@ class SubscriptionMatcher:
         cap = _pow2(total)
         fn = self._match_fn(cap, cpad)
         out = fn(self._indices_d, self._row_of_d, self._put(changed))
+        dispatch_mod.count_dispatch()  # the masked-gather launch
         ph.lap("match")
+        # Landing the matched pairs is the match's one device->host
+        # sync (counted; the pair count was host-known before launch).
+        dispatch_mod.count_host_sync()
         pairs = np.asarray(out)
         ph.lap("download")
         return pairs[pairs[:, 0] >= 0]
@@ -286,6 +291,7 @@ class SubscriptionMatcher:
         self._indices_d = self._scatter_fn(dpad)(
             self._indices_d, self._put(pos), self._put(val)
         )
+        dispatch_mod.count_dispatch()  # the point-scatter launch
         self.scatters += 1
 
     def _scatter_fn(self, dpad: int):
@@ -294,7 +300,16 @@ class SubscriptionMatcher:
         if fn is None:
             import jax
 
-            fn = jax.jit(lambda ind, pos, val: ind.at[pos].set(val))
+            # The incidence table is permanently device-resident:
+            # donating it through each point-scatter updates it in
+            # place (the `self._indices_d = fn(self._indices_d, ...)`
+            # rebind at the call site is the donation-safe pattern the
+            # lint's device-sync-taint rule checks) instead of
+            # allocating a fresh table per subscribe/unsubscribe burst.
+            fn = jax.jit(
+                lambda ind, pos, val: ind.at[pos].set(val),
+                donate_argnums=(0,),
+            )
             self._fns[key] = fn
         return fn
 

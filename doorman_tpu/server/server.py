@@ -39,6 +39,7 @@ from doorman_tpu.proto.grpc_api import CapacityServicer, add_capacity_servicer
 from doorman_tpu.server import config as config_mod
 from doorman_tpu.server.election import Election
 from doorman_tpu.solver.engine import PipelinedTicker
+from doorman_tpu.utils import dispatch as dispatch_mod
 from doorman_tpu.utils.backoff import MAX_BACKOFF, MIN_BACKOFF, VERY_LONG_TIME, backoff
 
 log = logging.getLogger(__name__)
@@ -108,6 +109,7 @@ class CapacityServer(CapacityServicer):
         flightrec_capacity: int = 512,
         flightrec_dir: Optional[str] = None,
         fuse_admission: bool = False,
+        fused_tick: bool = True,
         tick_pipeline_depth: int = 1,
         stream_push: bool = False,
         max_streams_per_band: int = 0,
@@ -218,6 +220,13 @@ class CapacityServer(CapacityServicer):
         # the store pack off the tick's critical path (engine.FusedStaging;
         # requires admission coalescing to be the write path).
         self._fuse_admission = bool(fuse_admission)
+        # Fused-tick mode for the resident solvers (the default): one
+        # packed staged upload + ONE staging->solve->delta launch + one
+        # download stream per tick, byte-identical to the round-trip
+        # multi-dispatch path (tests/test_fused_tick.py pins it);
+        # fused_tick=False keeps the round-trip path for baseline
+        # measurement and triage (doc/operations.md).
+        self._fused_tick = bool(fused_tick)
         # Optional device mesh for the resident solvers: table rows
         # shard across its devices and each tick is a shard_mapped
         # solve (store contents stay bit-identical to the single-device
@@ -300,6 +309,9 @@ class CapacityServer(CapacityServicer):
             self.flightrec = None
         self._flight_phase_prev: Dict[str, float] = {}
         self._flight_fed_prev: Dict[str, float] = {}
+        # Dispatch accounting baseline (utils.dispatch is process-
+        # global and monotone; each tick record carries the delta).
+        self._flight_dispatch_prev: Dict[str, int] = {}
         # Last SLO evaluation (evaluate_slos); status() and /debug/slo
         # read it. None until the first evaluation.
         self.last_slo: Optional[dict] = None
@@ -601,6 +613,7 @@ class CapacityServer(CapacityServicer):
                 # Grant delivery rides the config's fastest refresh
                 # cadence relative to this server's tick cadence.
                 rotate_ticks=None, tick_interval=self.tick_interval,
+                fused=self._fused_tick,
             )
             if self._fuse_admission and self._admission is not None:
                 # Admission-fused staging: the coalescer's windows
@@ -634,6 +647,7 @@ class CapacityServer(CapacityServicer):
                 engine, dtype=dtype, clock=self._clock,
                 mesh=self._solver_mesh,
                 rotate_ticks=None, tick_interval=self.tick_interval,
+                fused=self._fused_tick,
             )
             if self.flightrec is not None:
                 self._resident_wide.on_anomaly = self._solver_anomaly
@@ -1258,6 +1272,23 @@ class CapacityServer(CapacityServicer):
             if lf.get("windows") or lf.get("rows"):
                 rec["fused_windows"] = int(lf.get("windows", 0))
                 rec["fused_rows"] = int(lf.get("rows", 0))
+        # Dispatch accounting: device dispatches (transfers + launches)
+        # and device->host syncs this tick asked of the accelerator,
+        # counted through the place()/land_parts chokepoints
+        # (utils.dispatch) — the fused-tick win as a per-tick number,
+        # not a claim. Process-global counters, so concurrent solver
+        # paths (narrow + wide) fold into one delta per record.
+        dcur = dispatch_mod.snapshot()
+        if self._flight_dispatch_prev:
+            rec["dispatches"] = (
+                dcur["dispatches"]
+                - self._flight_dispatch_prev["dispatches"]
+            )
+            rec["host_syncs"] = (
+                dcur["host_syncs"]
+                - self._flight_dispatch_prev["host_syncs"]
+            )
+        self._flight_dispatch_prev = dcur
         depth_used = max(
             len(self._resident_pipe), len(self._resident_wide_pipe)
         )
@@ -1936,6 +1967,12 @@ class CapacityServer(CapacityServicer):
                     + len(self._resident_wide_pipe)
                 ),
             },
+            # Fused-tick mode and the process-cumulative dispatch
+            # accounting (device dispatches / host syncs through the
+            # counted chokepoints; per-tick deltas ride the flight
+            # recorder as `dispatches`/`host_syncs`).
+            "fused_tick": self._fused_tick,
+            "dispatch": dispatch_mod.snapshot(),
             # Admission-fused staging counters (None: fusion off or the
             # resident path not active yet); see doc/bench.md.
             "fused_staging": (

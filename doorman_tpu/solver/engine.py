@@ -43,6 +43,7 @@ import numpy as np
 
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.obs.phases import PhaseRecorder
+from doorman_tpu.utils import dispatch as dispatch_mod
 
 log = logging.getLogger(__name__)
 
@@ -56,6 +57,7 @@ __all__ = [
     "PipelinedTicker",
     "BatchTickAdapter",
     "place",
+    "count_launch",
     "landed_rows",
     "landed_changed",
     "bf16_exact",
@@ -77,10 +79,16 @@ __all__ = [
 # "match" is the stream fanout's device-side changed-row -> subscriber
 # intersection (server/match.py): the incidence staging scatters plus
 # the masked-gather launch; the matched-pair landing rides "download"
-# like any delivery byte.
+# like any delivery byte. "fused" is the fused-tick device window: the
+# SINGLE staged-buffer placement plus the one-launch
+# staging->solve->delta executable plus the download kickoff — in
+# fused mode it replaces the separate "upload" and "solve" laps (which
+# the round-trip mode keeps), so a flight-recorder dump says at a
+# glance which mode a tick ran in.
 PHASES = (
     "sweep", "drain", "config", "pack", "staging", "upload", "solve",
-    "aggregate", "match", "download", "apply", "delta", "rebuild",
+    "fused", "aggregate", "match", "download", "apply", "delta",
+    "rebuild",
 )
 
 
@@ -98,12 +106,23 @@ def place(arr, *, device=None, sharding=None):
     """The tick engines' single placement chokepoint: every device
     table, config column, and staged per-tick block lands through here,
     so the single-device path (explicit device or backend default) and
-    the mesh path (a NamedSharding) cannot drift apart."""
+    the mesh path (a NamedSharding) cannot drift apart. Each call is
+    one host->device transfer op and counts as one dispatch
+    (utils.dispatch) — the fused-tick accounting's upload half."""
     import jax
 
+    dispatch_mod.count_dispatch()
     if sharding is not None:
         return jax.device_put(arr, sharding)
     return jax.device_put(arr, device)
+
+
+def count_launch(n: int = 1) -> None:
+    """Record a tick-executable launch in the dispatch accounting
+    (utils.dispatch). Every engine's jitted tick call site counts
+    itself here — the launch half of the per-tick `dispatches`
+    number the flight recorder and bench report."""
+    dispatch_mod.count_dispatch(n)
 
 
 def landed_rows(handle: "TickHandle") -> np.ndarray:
@@ -134,6 +153,10 @@ def landed_changed(handle: "TickHandle") -> "np.ndarray | None":
     shard-major order — exactly like landed_rows."""
     if handle.changed is None:
         return None
+    if not isinstance(handle.changed, np.ndarray):
+        # Landing a device mask is one device->host sync (the fused
+        # path avoids it by packing the mask into the delivery slab).
+        dispatch_mod.count_host_sync()
     ch = np.asarray(handle.changed)
     if handle.shard_counts is None:
         return ch[: handle.n_sel].astype(bool)
@@ -202,6 +225,11 @@ class TickHandle:
     # [n_dev, Sb] per-shard blocks aligned with `out`. None when the
     # engine does not track deltas.
     changed: object = None
+    # Fused ticks pack the changed mask INTO the delivered slab as its
+    # last `mask_rows` rows ({0,1} in the download dtype, flattened
+    # slot-major), so the grants and the mask land in one download
+    # stream instead of two. 0 = the mask (if any) rides `changed`.
+    mask_rows: int = 0
 
 
 def idle_handle(now: float) -> TickHandle:
@@ -477,6 +505,7 @@ class TickEngineBase:
         tick_interval: "float | None" = None,
         download_dtype=None,
         config_put: "Callable | None" = None,
+        fused: bool = True,
     ):
         import jax
 
@@ -527,6 +556,15 @@ class TickEngineBase:
         self._track_deltas = False
         self._changed_lock = threading.Lock()
         self._changed_rids: set = set()  # guarded-by: self._changed_lock
+        # Fused-tick mode (the default): one packed staged-buffer
+        # placement + ONE jitted staging->solve->delta launch + one
+        # download stream per tick, instead of a device dispatch per
+        # staged block. Byte-identical to the round-trip mode (the
+        # executable runs the same scatter/solve/compare ops; only the
+        # transfer packing differs — pinned by tests/test_fused_tick
+        # .py); `fused=False` keeps the multi-dispatch path for
+        # baseline measurement and triage (doc/operations.md).
+        self._fused = bool(fused)
         # Admission-fused staging (narrow path); attach_staging() wires
         # it. None keeps the round-trip pack on every tick.
         self._staging: "FusedStaging | None" = None
@@ -555,6 +593,17 @@ class TickEngineBase:
     def rotate_ticks(self, value: int) -> None:
         self._rotate_override = max(int(value), 1)
         self._rotate = self._rotate_override
+
+    @property
+    def fused_tick(self) -> bool:
+        return self._fused
+
+    @fused_tick.setter
+    def fused_tick(self, value: bool) -> None:
+        value = bool(value)
+        if value != self._fused:
+            self._fused = value
+            self._tick_fns.clear()
 
     def attach_staging(self) -> FusedStaging:
         """Enable admission-fused staging; returns the buffer the
@@ -726,9 +775,21 @@ class TickEngineBase:
         # Parts were split (and their async copies started) at
         # dispatch; land them in order into one buffer. The changed-row
         # mask (delta tracking) rides the same download lap — it is a
-        # delivery byte like the grants themselves.
-        gets = landed_rows(handle)
-        changed = landed_changed(handle)
+        # delivery byte like the grants themselves. Fused ticks land
+        # grants AND mask from the one packed slab (see
+        # TickHandle.mask_rows); round-trip ticks land them separately.
+        if handle.mask_rows:
+            from doorman_tpu.utils.transfer import land_parts
+
+            slab = np.asarray(land_parts(handle.out), np.float64)
+            n_slots = slab.shape[0] - handle.mask_rows
+            gets = slab[: handle.n_sel]
+            changed = (
+                slab[n_slots:].reshape(-1)[: handle.n_sel] != 0.0
+            )
+        else:
+            gets = landed_rows(handle)
+            changed = landed_changed(handle)
         ph.lap("download")
         applied = self._apply_grants(handle, gets)
         ph.lap("apply")
@@ -781,11 +842,16 @@ class PipelinedTicker:
     `depth` ticks stay in flight, so the delivery download of tick N
     lands concurrent with the staging and solve of ticks N+1..N+depth-1
     (the server's tick loop and bench.py both drive through this).
-    Handles are stored WITH their engine, and a handle whose engine was
-    replaced (mastership flip swapped the store engine) is dropped, not
+    Default depth 3 (>2): with the fused one-launch tick the download
+    is the dominant async leg, and depth 3 keeps a tick's delivery
+    landing while the NEXT tick stages its upload and the one after
+    solves — the write-back deferral stays bounded by the delivery
+    rotation's freshness argument exactly as at depth 2. Handles are
+    stored WITH their engine, and a handle whose engine was replaced
+    (mastership flip swapped the store engine) is dropped, not
     collected — its row ids belong to a different engine."""
 
-    def __init__(self, depth: int = 2):
+    def __init__(self, depth: int = 3):
         self.depth = max(int(depth), 1)
         self._queue: deque = deque()
 

@@ -54,6 +54,43 @@ def _kernel(wants_ref, has_ref, sub_ref, active_ref, cap_ref, kind_ref,
     )
 
 
+def _fused_kernel(wants_ref, has_ref, sub_ref, active_ref, cap_ref,
+                  kind_ref, learn_ref, static_ref, prev_ref, deliv_ref,
+                  gets_ref, prev_out_ref, changed_ref):
+    """One VMEM pass per row tile: every lane solve + the delivered-
+    grant delta against the resident previous-grants tile + the prev
+    update. The XLA formulation re-reads `gets` and `prev` from HBM
+    for the compare and the scatter-style update; here they never
+    leave VMEM — the fused tick's delta tracking costs zero extra HBM
+    traffic over the solve itself."""
+    gets = solve_lanes(
+        wants_ref[:],
+        has_ref[:],
+        sub_ref[:],
+        active_ref[:] > 0,
+        cap_ref[:],
+        kind_ref[:],
+        learn_ref[:] > 0,
+        static_ref[:],
+        segsum=lambda v: jnp.sum(v, axis=1, keepdims=True),
+        segmax=lambda v: jnp.max(v, axis=1, keepdims=True),
+        expand=lambda t: t,
+    )
+    gets_ref[:] = gets
+    prev = prev_ref[:]
+    out = gets.astype(prev.dtype)
+    deliv = deliv_ref[:] > 0  # [T, 1] column: delivered this tick
+    diff = jnp.any(out != prev, axis=1, keepdims=True)
+    changed_ref[:] = jnp.where(
+        deliv & diff,
+        jnp.ones((), wants_ref.dtype),
+        jnp.zeros((), wants_ref.dtype),
+    )
+    # prev tracks what the store of record last SAW: only delivered
+    # rows advance, the rest keep their previous delivery vintage.
+    prev_out_ref[:] = jnp.where(deliv, out, prev)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def solve_dense_pallas(batch: DenseBatch, interpret: bool = False) -> jax.Array:
     """Grants [R, K]; bit-compatible with dense.solve_dense.
@@ -103,3 +140,80 @@ def solve_dense_pallas(batch: DenseBatch, interpret: bool = False) -> jax.Array:
         col(batch.static_capacity, dtype),
     )
     return gets[:R, :K]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_tick_pallas(
+    batch: DenseBatch,
+    prev: jax.Array,  # [R, K] previous DELIVERED grants (download dtype)
+    delivered: jax.Array,  # [R] {0,1}: rows the tick delivers
+    interpret: bool = False,
+) -> "tuple[jax.Array, jax.Array, jax.Array]":
+    """The fused-tick row-tile kernel: (gets, prev_new, changed).
+
+    One grid step loads a row tile into VMEM and produces the grants,
+    the advanced previous-grants tile, and the per-row changed flag in
+    the same pass — solve + delta compare + prev update never touch
+    HBM between each other. `gets` is bit-compatible with
+    `solve_dense_pallas` (the solve is the same `solve_lanes` body);
+    `changed[r]` is True exactly when row r is delivered this tick AND
+    its grants (in prev's dtype) differ from `prev[r]`; `prev_new`
+    advances delivered rows and preserves the rest. `interpret=True`
+    is the CPU parity-test path (tests/test_fused_tick.py); on TPU
+    leave it False.
+    """
+    R, K = batch.wants.shape
+    dtype = batch.wants.dtype
+    kpad = (-K) % LANE
+    Kp = K + kpad
+    tile_r = tile_rows(R, Kp, jnp.dtype(dtype).itemsize)
+    rpad = (-R) % tile_r
+    Rp = R + rpad
+
+    def tile(x):  # [R, K] compute-dtype, padded
+        return pad_tile(x.astype(dtype), rpad, kpad)
+
+    def col(x, cdtype):  # [R] -> [Rp, 1]
+        return pad_col(x.astype(cdtype), rpad)
+
+    rows, cols = row_spec(tile_r, Kp), col_spec(tile_r)
+    prev_dtype = prev.dtype
+    gets, prev_new, changed = pl.pallas_call(
+        _fused_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((Rp, Kp), dtype),
+            jax.ShapeDtypeStruct((Rp, Kp), prev_dtype),
+            jax.ShapeDtypeStruct((Rp, 1), dtype),
+        ],
+        grid=(Rp // tile_r,),
+        in_specs=[
+            rows,  # wants
+            rows,  # has
+            rows,  # subclients
+            rows,  # active mask
+            cols,  # capacity
+            cols,  # algo_kind
+            cols,  # learning mask
+            cols,  # static_capacity
+            rows,  # previous delivered grants
+            cols,  # delivered mask
+        ],
+        out_specs=[rows, rows, cols],
+        interpret=interpret,
+    )(
+        tile(batch.wants),
+        tile(batch.has),
+        tile(batch.subclients),
+        tile(batch.active),
+        col(batch.capacity, dtype),
+        col(batch.algo_kind, jnp.int32),
+        col(batch.learning, dtype),
+        col(batch.static_capacity, dtype),
+        pad_tile(prev, rpad, kpad),
+        col(delivered, dtype),
+    )
+    return (
+        gets[:R, :K],
+        prev_new[:R, :K],
+        changed[:R, 0] > 0,
+    )

@@ -77,6 +77,7 @@ from doorman_tpu.solver.engine import (
     TickHandle,
     bf16_exact,
     ceil_to,
+    count_launch,
     place,
 )
 from doorman_tpu.solver.engine import _BF16
@@ -112,6 +113,7 @@ class ResidentDenseSolver(TickEngineBase):
         rotate_ticks: "int | None" = 8,
         tick_interval: "float | None" = None,
         download_dtype=None,
+        fused: bool = True,
     ):
         super().__init__(
             engine,
@@ -122,6 +124,7 @@ class ResidentDenseSolver(TickEngineBase):
             rotate_ticks=rotate_ticks,
             tick_interval=tick_interval,
             download_dtype=download_dtype,
+            fused=fused,
         )
         self._rows: List[Resource] = []
         self._row_lut = np.full(1, -1, np.int64)
@@ -250,8 +253,12 @@ class ResidentDenseSolver(TickEngineBase):
     def _fair_rows(self):
         """Device array of FAIR_SHARE row indices, padded to a bucketed
         static shape (single device: [Fb]; mesh: per-shard [n_dev, Fb]
-        shard-local blocks). None when no row runs FAIR_SHARE. Rebuilt
-        when the config's kind vector object moves (epoch changes)."""
+        shard-local blocks). A cached zeros block when no row runs
+        FAIR_SHARE — the solve never reads it then (the lane is
+        compiled away), and caching it keeps the per-tick dispatch
+        count at its floor instead of re-placing a throwaway block
+        every tick. Rebuilt when the config's kind vector object moves
+        (epoch changes)."""
         kind_h = self._config.kind_h
         if kind_h is self._fair_kinds:
             return self._fair_rows_d
@@ -260,8 +267,13 @@ class ResidentDenseSolver(TickEngineBase):
             kind_h[: self._R] == int(AlgoKind.FAIR_SHARE)
         )[0].astype(np.int64)
         if not len(fair):
-            self._fair_rows_d = None
-            return None
+            if self._meshrows is None:
+                self._fair_rows_d = self._put(np.zeros(8, np.int32))
+            else:
+                self._fair_rows_d = self._put_rows(
+                    np.zeros((self._meshrows.n_dev, 8), np.int32)
+                )
+            return self._fair_rows_d
         if self._meshrows is None:
             Fb = ceil_to(len(fair), 8)
             self._fair_rows_d = self._put(
@@ -509,6 +521,300 @@ class ResidentDenseSolver(TickEngineBase):
         self._tick_fns[key] = tick
         return tick
 
+    def _tick_fn_fused(self, Da: int, Df: int, Sb: int, lanes: frozenset,
+                       use_bf16: bool):
+        """The one-launch fused tick: the staged blocks arrive as ONE
+        uint8 buffer (packed host-side in `_launch`), bitcast apart
+        in-program at static offsets, scattered, solved, delta-compared
+        — and in tracked mode the changed mask is packed INTO the
+        delivered slab so grants and mask land in one download stream.
+        Every per-block op is byte-for-byte the round-trip executable's
+        (same scatters, same solve, same compare); only the transfer
+        packing differs, which is what makes fused-vs-unfused byte
+        identity hold by construction (pinned by tests/test_fused_tick
+        .py). On TPU the solve+delta run in the fused pallas row-tile
+        kernel (pallas_dense.fused_tick_pallas): one VMEM pass per row
+        tile instead of XLA re-reading gets/prev from HBM."""
+        track = self._track_deltas
+        key = ("fused", Da, Df, Sb, self._kfill, lanes, track, use_bf16)
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from doorman_tpu.solver.batch import _committed_platform
+        from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+        use_pallas = (
+            _committed_platform(self._wants) == "tpu"
+            and self._dtype == np.float32
+        )
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import (
+                fused_tick_pallas,
+                solve_dense_pallas,
+            )
+
+        kfill = self._kfill
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        itemsize = int(np.dtype(dtype).itemsize)
+        aw_item = 2 if use_bf16 else itemsize
+        # Static buffer layout (byte offsets; assembly order matches
+        # `_pack_fused_buffer`): fused int32 index vector, wants-only
+        # block (bf16 when the round trip is exact), full-row
+        # has/subclients block, active flags as raw uint8 last (no
+        # alignment constraint).
+        n_idx = (Da + Df + Sb) * 4
+        n_aw = Da * kfill * aw_item
+        n_fb = 2 * Df * kfill * itemsize
+        Mb = -(-Sb // kfill)  # changed-mask rows appended to the slab
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+
+        def unpack(buf):
+            idx = jax.lax.bitcast_convert_type(
+                buf[:n_idx].reshape(-1, 4), jnp.int32
+            )
+            o = n_idx
+            a_w = jax.lax.bitcast_convert_type(
+                buf[o : o + n_aw].reshape(-1, aw_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            ).reshape(Da, kfill)
+            o += n_aw
+            f_block = jax.lax.bitcast_convert_type(
+                buf[o : o + n_fb].reshape(-1, itemsize), jdtype
+            ).reshape(2, Df, kfill)
+            o += n_fb
+            f_act = (buf[o : o + Df * kfill] != 0).reshape(Df, kfill)
+            return idx, a_w, f_block, f_act
+
+        def stage_and_batch(wants, has, sub, act, buf, cap, kind,
+                            learn, statc):
+            idx, a_w, f_block, f_act = unpack(buf)
+            a_idx = idx[:Da]
+            f_idx = idx[Da : Da + Df]
+            sel_idx = idx[Da + Df :]
+            wants = wants.at[a_idx, :kfill].set(a_w.astype(dtype))
+            has = has.at[f_idx, :kfill].set(f_block[0])
+            sub = sub.at[f_idx, :kfill].set(f_block[1])
+            act = act.at[f_idx, :kfill].set(f_act)
+            batch = DenseBatch(
+                wants=wants, has=has, subclients=sub, active=act,
+                capacity=cap, algo_kind=kind, learning=learn,
+                static_capacity=statc,
+            )
+            return wants, sub, act, batch, sel_idx
+
+        if track:
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(wants, has, sub, act, prev, buf, fair, cap, kind,
+                     learn, statc):
+                wants, sub, act, batch, sel_idx = stage_and_batch(
+                    wants, has, sub, act, buf, cap, kind, learn, statc
+                )
+                if use_pallas:
+                    # Delivered-row mask from the gather set: padding
+                    # slots repeat real rows, so duplicate scatters
+                    # write the same 1.
+                    delivered = (
+                        jnp.zeros(batch.wants.shape[0], dtype)
+                        .at[sel_idx]
+                        .set(jnp.ones((), dtype))
+                    )
+                    gets, prev, changed_rows = fused_tick_pallas(
+                        batch, prev, delivered
+                    )
+                    out = gets[sel_idx, :kfill].astype(out_dtype)
+                    changed = changed_rows[sel_idx]
+                else:
+                    gets = solve_dense(
+                        batch, lanes=lanes,
+                        fair_rows=fair if want_fair else None,
+                    )
+                    out = gets[sel_idx, :kfill].astype(out_dtype)
+                    changed = (out != prev[sel_idx, :kfill]).any(axis=1)
+                    prev = prev.at[sel_idx, :kfill].set(out)
+                mask = jnp.pad(
+                    changed.astype(out_dtype), (0, Mb * kfill - Sb)
+                ).reshape(Mb, kfill)
+                slab = jnp.concatenate([out, mask], axis=0)
+                return wants, gets, sub, act, prev, slab
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(wants, has, sub, act, buf, fair, cap, kind, learn,
+                     statc):
+                wants, sub, act, batch, sel_idx = stage_and_batch(
+                    wants, has, sub, act, buf, cap, kind, learn, statc
+                )
+                if use_pallas:
+                    gets = solve_dense_pallas(batch)
+                else:
+                    gets = solve_dense(
+                        batch, lanes=lanes,
+                        fair_rows=fair if want_fair else None,
+                    )
+                out = gets[sel_idx, :kfill].astype(out_dtype)
+                return wants, gets, sub, act, out
+
+        self._tick_fns[key] = tick
+        return tick
+
+    def _tick_fn_mesh_fused(self, Da: int, Df: int, Sb: int,
+                            lanes: frozenset, use_bf16: bool):
+        """Mesh variant of the fused upload: each shard's staged
+        blocks arrive as one [1, B] uint8 slice of the sharded buffer
+        and bitcast apart in-shard; the solve/delta body is the mesh
+        round-trip executable's. The delivery keeps the mesh output
+        layout (per-shard [Sb, kfill] blocks + separate changed mask):
+        the upload side is where the mesh tick pays per-block
+        dispatches, the download is already one stream per shard."""
+        track = self._track_deltas
+        key = (
+            "fused_mesh", Da, Df, Sb, self._kfill, lanes, track, use_bf16
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.solver.batch import _committed_platform
+        from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+        use_pallas = (
+            _committed_platform(self._wants) == "tpu"
+            and self._dtype == np.float32
+        )
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+        kfill = self._kfill
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        axes = self._meshrows.axes
+        itemsize = int(np.dtype(dtype).itemsize)
+        aw_item = 2 if use_bf16 else itemsize
+        n_idx = (Da + Df + Sb) * 4
+        n_aw = Da * kfill * aw_item
+        n_fb = 2 * Df * kfill * itemsize
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+
+        def unpack(buf):
+            idx = jax.lax.bitcast_convert_type(
+                buf[:n_idx].reshape(-1, 4), jnp.int32
+            )
+            o = n_idx
+            a_w = jax.lax.bitcast_convert_type(
+                buf[o : o + n_aw].reshape(-1, aw_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            ).reshape(Da, kfill)
+            o += n_aw
+            f_block = jax.lax.bitcast_convert_type(
+                buf[o : o + n_fb].reshape(-1, itemsize), jdtype
+            ).reshape(2, Df, kfill)
+            o += n_fb
+            f_act = (buf[o : o + Df * kfill] != 0).reshape(Df, kfill)
+            return idx, a_w, f_block, f_act
+
+        def _core(wants, has, sub, act, buf, fair, cap, kind, learn,
+                  statc):
+            idx, a_w, f_block, f_act = unpack(buf[0])
+            a_idx = idx[:Da]
+            f_idx = idx[Da : Da + Df]
+            sel_idx = idx[Da + Df :]
+            wants = wants.at[a_idx, :kfill].set(
+                a_w.astype(dtype), mode="drop"
+            )
+            has = has.at[f_idx, :kfill].set(f_block[0], mode="drop")
+            sub = sub.at[f_idx, :kfill].set(f_block[1], mode="drop")
+            act = act.at[f_idx, :kfill].set(f_act, mode="drop")
+            batch = DenseBatch(
+                wants=wants, has=has, subclients=sub, active=act,
+                capacity=cap, algo_kind=kind, learning=learn,
+                static_capacity=statc,
+            )
+            if use_pallas:
+                gets = solve_dense_pallas(batch)
+            else:
+                gets = solve_dense(
+                    batch, lanes=lanes,
+                    fair_rows=fair[0] if want_fair else None,
+                )
+            out = jnp.take(
+                gets, sel_idx, axis=0, mode="clip",
+                indices_are_sorted=True,
+            )[:, :kfill].astype(out_dtype)
+            return wants, gets, sub, act, out, sel_idx
+
+        rowk = P(axes, None)
+        row = P(axes)
+        dev2 = P(axes, None, None)
+        in_specs_tail = (
+            row,  # fused uint8 buffer [n_dev, B]
+            rowk,  # fair rows [n_dev, Fb] (shard-local)
+            row, row, row, row,  # per-row config
+        )
+
+        if track:
+            def body(wants, has, sub, act, prev, buf, fair, cap, kind,
+                     learn, statc):
+                wants, gets, sub, act, out, sel_idx = _core(
+                    wants, has, sub, act, buf, fair, cap, kind, learn,
+                    statc,
+                )
+                prev_sel = jnp.take(
+                    prev, sel_idx, axis=0, mode="clip",
+                    indices_are_sorted=True,
+                )[:, :kfill]
+                changed = (out != prev_sel).any(axis=1)
+                prev = prev.at[sel_idx, :kfill].set(out, mode="drop")
+                return wants, gets, sub, act, prev, out[None], changed[None]
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(
+                    rowk, rowk, rowk, rowk, rowk, dev2, P(axes, None),
+                ),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(*args):
+                return mapped(*args)
+        else:
+            def body(wants, has, sub, act, buf, fair, cap, kind, learn,
+                     statc):
+                wants, gets, sub, act, out, _ = _core(
+                    wants, has, sub, act, buf, fair, cap, kind, learn,
+                    statc,
+                )
+                return wants, gets, sub, act, out[None]
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(rowk, rowk, rowk, rowk, dev2),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(*args):
+                return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
+
     # -- phases -------------------------------------------------------
 
     def _drain(self, ph: PhaseRecorder):
@@ -722,16 +1028,76 @@ class ResidentDenseSolver(TickEngineBase):
         idx_host = np.concatenate([a_idx, f_idx, sel_pad]).astype(np.int32)
         lanes = self._config.lanes()
         fair_d = self._fair_rows()
-        ph.lap("staging")
+        cfg = self._config
+        from doorman_tpu.utils.transfer import start_download
 
+        if self._fused:
+            # One-launch fused tick: pack every staged block into one
+            # uint8 buffer (the executable bitcasts it apart at static
+            # offsets), one placement, one launch, one download stream
+            # — with the changed mask packed INTO the delivered slab
+            # when delta tracking is on. Byte-identical to the
+            # round-trip tail below (same scatters/solve/compare ops).
+            use_bf16 = a_w.dtype != dtype
+            buf = np.concatenate([
+                idx_host.view(np.uint8),
+                np.ascontiguousarray(a_w).view(np.uint8).ravel(),
+                np.ascontiguousarray(f_block).view(np.uint8).ravel(),
+                f_act.view(np.uint8).ravel(),
+            ])
+            ph.lap("staging")
+            tick = self._tick_fn_fused(Da, Df, Sb, lanes, use_bf16)
+            buf_d = self._put(buf)
+            mask_rows = 0
+            changed_d = None
+            if self._track_deltas:
+                (
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev, buf_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+                mask_rows = -(-Sb // kfill)
+            else:
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    buf_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            count_launch()
+            # One download stream: the fused slab already carries
+            # grants + mask contiguously, and a single async copy is
+            # the dispatch floor (the round-trip tail's split is for
+            # tunneled-link bandwidth, where several copies must be in
+            # flight; on a local accelerator one stream of ~1MB is
+            # bandwidth-bound either way).
+            out = start_download(out, chunks=1)
+            ph.lap("fused")
+            self.last_fused = {"windows": fwin, "rows": rows_hit}
+            return TickHandle(
+                out=out,
+                sel_rows=sel,
+                rids=self._rids[sel],
+                versions=self._uploaded_versions[sel],
+                keep_has=cfg.learn_h[sel].astype(np.uint8),
+                n_sel=n_sel,
+                dispatched_at=now,
+                fused_windows=fwin,
+                fused_rows=rows_hit,
+                changed=changed_d,
+                mask_rows=mask_rows,
+            )
+
+        ph.lap("staging")
         put = self._put
         tick = self._tick_fn(Da, Df, Sb, lanes)
-        if fair_d is None:
-            fair_d = put(np.zeros(8, np.int32))
         staged = (put(idx_host), put(a_w), put(f_block), put(f_act))
         ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_act_d = staged
-        cfg = self._config
         changed_d = None
         if self._track_deltas:
             (
@@ -750,14 +1116,13 @@ class ResidentDenseSolver(TickEngineBase):
                 idx_d, a_w_d, f_block_d, f_act_d, fair_d,
                 cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
             )
+        count_launch()
         # Start the grant download as SEVERAL async streams: the
         # tunneled device link only reaches full bandwidth with
         # overlapping copies in flight, and a single whole-slab copy
         # would serialize the download behind one round-trip. The split
         # costs a few small on-device slice allocations (measured:
         # ~halves the download lap and tightens the tick's p90).
-        from doorman_tpu.utils.transfer import start_download
-
         out = start_download(out)
         # "solve": the jitted tick call + download kickoff. On the CPU
         # backend this is the synchronous device solve; on TPU it is
@@ -847,6 +1212,28 @@ class ResidentDenseSolver(TickEngineBase):
         ).astype(np.int32)
         lanes = self._config.lanes()
         fair_d = self._fair_rows()
+        fused = self._fused
+        if fused:
+            # Fused upload: one [n_dev, B] uint8 buffer whose per-shard
+            # slice carries that shard's staged blocks back to back
+            # (same static layout the fused executable unpacks); the
+            # sharded placement moves each shard's bytes to its own
+            # device in ONE dispatch instead of four. The delivery keeps
+            # the mesh layout (one stream per shard + separate changed
+            # mask) — the mesh download is already at its dispatch
+            # floor.
+            n_dev_ax = idx_host.shape[0]
+            buf_host = np.concatenate(
+                [
+                    idx_host.view(np.uint8).reshape(n_dev_ax, -1),
+                    np.ascontiguousarray(a_w_b)
+                    .view(np.uint8).reshape(n_dev_ax, -1),
+                    np.ascontiguousarray(f_block)
+                    .view(np.uint8).reshape(n_dev_ax, -1),
+                    f_a_b.view(np.uint8).reshape(n_dev_ax, -1),
+                ],
+                axis=1,
+            )
         ph.lap("staging")
 
         itemsize = dtype.itemsize
@@ -861,33 +1248,58 @@ class ResidentDenseSolver(TickEngineBase):
             counts_sel * kfill * np.dtype(self._out_dtype).itemsize,
         )
         put = self._put_rows
-        tick = self._tick_fn_mesh(Da, Df, Sb, lanes)
-        if fair_d is None:
-            fair_d = put(np.zeros((n_dev, 8), np.int32))
-        staged = (put(idx_host), put(a_w_b), put(f_block), put(f_a_b))
-        ph.lap("upload")
-        idx_d, a_w_d, f_block_d, f_a_d = staged
         cfg = self._config
         changed_d = None
-        if self._track_deltas:
-            (
-                self._wants, self._has, self._sub, self._act,
-                self._prev, out, changed_d
-            ) = tick(
-                self._wants, self._has, self._sub, self._act, self._prev,
-                idx_d, a_w_d, f_block_d, f_a_d, fair_d,
-                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-            )
+        if fused:
+            use_bf16 = a_w_b.dtype != dtype
+            tick = self._tick_fn_mesh_fused(Da, Df, Sb, lanes, use_bf16)
+            buf_d = put(buf_host)
+            if self._track_deltas:
+                (
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev, out, changed_d
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev, buf_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            else:
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    buf_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            count_launch()
+            out = start_sharded_download(out)
+            ph.lap("fused")
         else:
-            (
-                self._wants, self._has, self._sub, self._act, out
-            ) = tick(
-                self._wants, self._has, self._sub, self._act,
-                idx_d, a_w_d, f_block_d, f_a_d, fair_d,
-                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-            )
-        out = start_sharded_download(out)
-        ph.lap("solve")
+            tick = self._tick_fn_mesh(Da, Df, Sb, lanes)
+            staged = (put(idx_host), put(a_w_b), put(f_block), put(f_a_b))
+            ph.lap("upload")
+            idx_d, a_w_d, f_block_d, f_a_d = staged
+            if self._track_deltas:
+                (
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev, out, changed_d
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    self._prev,
+                    idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            else:
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            count_launch()
+            out = start_sharded_download(out)
+            ph.lap("solve")
         self.last_fused = {"windows": fwin, "rows": rows_hit}
         return TickHandle(
             out=out,

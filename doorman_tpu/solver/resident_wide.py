@@ -76,6 +76,7 @@ from doorman_tpu.solver.engine import (
     bf16_exact,
     ceil_to,
     compact_index_dtype,
+    count_launch,
 )
 from doorman_tpu.solver.engine import _BF16
 from doorman_tpu.solver.resident import _ceil_to  # noqa: F401 (compat)
@@ -104,6 +105,7 @@ class WideResidentSolver(TickEngineBase):
         tick_interval: "float | None" = None,
         download_dtype=None,
         chunk_width: "int | None" = None,
+        fused: bool = True,
     ):
         super().__init__(
             engine,
@@ -115,6 +117,7 @@ class WideResidentSolver(TickEngineBase):
             tick_interval=tick_interval,
             download_dtype=download_dtype,
             config_put=self._put_rep,
+            fused=fused,
         )
         self._W = int(chunk_width or DENSE_MAX_K)
         self._res: List[Resource] = []
@@ -360,6 +363,236 @@ class WideResidentSolver(TickEngineBase):
         self._tick_fns[key] = tick
         return tick
 
+    def _fused_layout(self, Dw: int, Df: int, Sb: int, use_bf16: bool):
+        """Static byte layout of the wide fused staging buffer (shared
+        between the host pack and the executable's unpack): flat slot
+        index blocks, value blocks, the delivery row set, and the
+        active flags as raw uint8 last (no alignment constraint)."""
+        idt_size = int(np.dtype(self._idx_dtype).itemsize)
+        itemsize = int(self._dtype.itemsize)
+        wval_item = 2 if use_bf16 else itemsize
+        sizes = (
+            Dw * idt_size,   # w_idx
+            Dw * wval_item,  # w_val (bf16 when exact)
+            Df * idt_size,   # f_idx
+            Df * itemsize,   # f_w
+            Df * itemsize,   # f_h
+            Df * itemsize,   # f_s
+            Sb * 4,          # sel (int32)
+            Df,              # f_a (uint8)
+        )
+        return sizes, idt_size, wval_item, itemsize
+
+    def _tick_fn_fused(self, Dw: int, Df: int, Sb: int, lanes: frozenset,
+                       use_bf16: bool):
+        """One-launch fused wide tick: the eight staged blocks arrive
+        as ONE uint8 buffer, bitcast apart at static offsets in-program
+        (see ResidentDenseSolver._tick_fn_fused for the idiom and the
+        byte-identity argument — every scatter/solve op here is the
+        round-trip executable's)."""
+        key = ("fused", Dw, Df, Sb, lanes, use_bf16, self._idx_dtype)
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from doorman_tpu.solver.dense import (
+            ChunkedDenseBatch,
+            solve_chunked,
+        )
+
+        Rp, W = self._Rp, self._W
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        row_seg = self._row_seg_d
+        sizes, idt_size, wval_item, itemsize = self._fused_layout(
+            Dw, Df, Sb, use_bf16
+        )
+        idt_j = jnp.dtype(self._idx_dtype)
+
+        def unpack(buf):
+            o = 0
+            parts = []
+            for n in sizes:
+                parts.append(buf[o : o + n])
+                o += n
+            w_idx = jax.lax.bitcast_convert_type(
+                parts[0].reshape(-1, idt_size), idt_j
+            )
+            w_val = jax.lax.bitcast_convert_type(
+                parts[1].reshape(-1, wval_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            )
+            f_idx = jax.lax.bitcast_convert_type(
+                parts[2].reshape(-1, idt_size), idt_j
+            )
+            f_w, f_h, f_s = (
+                jax.lax.bitcast_convert_type(
+                    p.reshape(-1, itemsize), jdtype
+                )
+                for p in parts[3:6]
+            )
+            sel_idx = jax.lax.bitcast_convert_type(
+                parts[6].reshape(-1, 4), jnp.int32
+            )
+            f_a = parts[7] != 0
+            return w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(wants, has, sub, act, buf, cap, kind, learn, statc):
+            (
+                w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+            ) = unpack(buf)
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val.astype(dtype))
+                .at[f_idx].set(f_w)
+                .reshape(Rp, W)
+            )
+            has = has.reshape(-1).at[f_idx].set(f_h).reshape(Rp, W)
+            sub = sub.reshape(-1).at[f_idx].set(f_s).reshape(Rp, W)
+            act = act.reshape(-1).at[f_idx].set(f_a).reshape(Rp, W)
+            gets = solve_chunked(
+                ChunkedDenseBatch(
+                    wants=wants, has=has, subclients=sub, active=act,
+                    row_seg=row_seg, capacity=cap, algo_kind=kind,
+                    learning=learn, static_capacity=statc,
+                ),
+                lanes=lanes,
+            )
+            out = gets[sel_idx, :].astype(out_dtype)
+            return wants, gets, sub, act, out
+
+        self._tick_fns[key] = tick
+        return tick
+
+    def _tick_fn_mesh_fused(self, Dw: int, Df: int, Sb: int,
+                            lanes: frozenset, use_bf16: bool):
+        """Mesh variant of the wide fused upload: each shard's staged
+        blocks arrive as one [1, B] slice of the sharded uint8 buffer
+        (shard-LOCAL flat indices, same drop semantics as the
+        round-trip mesh executable)."""
+        key = (
+            "fused_mesh", Dw, Df, Sb, lanes, use_bf16, self._idx_dtype
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.parallel.sharded import resident_chunk_reduces
+        from doorman_tpu.solver.lanes import solve_lanes
+
+        mr = self._meshrows
+        axes = mr.axes
+        Rp, W = self._Rp, self._W
+        Rl = Rp // mr.n_dev
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        sizes, idt_size, wval_item, itemsize = self._fused_layout(
+            Dw, Df, Sb, use_bf16
+        )
+        idt_j = jnp.dtype(self._idx_dtype)
+        segsum, segmax = resident_chunk_reduces(
+            self._mesh, self._row_seg_h, self._Sp, Rl
+        )
+
+        def unpack(buf):
+            o = 0
+            parts = []
+            for n in sizes:
+                parts.append(buf[o : o + n])
+                o += n
+            w_idx = jax.lax.bitcast_convert_type(
+                parts[0].reshape(-1, idt_size), idt_j
+            )
+            w_val = jax.lax.bitcast_convert_type(
+                parts[1].reshape(-1, wval_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            )
+            f_idx = jax.lax.bitcast_convert_type(
+                parts[2].reshape(-1, idt_size), idt_j
+            )
+            f_w, f_h, f_s = (
+                jax.lax.bitcast_convert_type(
+                    p.reshape(-1, itemsize), jdtype
+                )
+                for p in parts[3:6]
+            )
+            sel_idx = jax.lax.bitcast_convert_type(
+                parts[6].reshape(-1, 4), jnp.int32
+            )
+            f_a = parts[7] != 0
+            return w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+
+        def body(wants, has, sub, act, row_seg, buf, cap, kind, learn,
+                 statc):
+            (
+                w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+            ) = unpack(buf[0])
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val.astype(dtype), mode="drop")
+                .at[f_idx].set(f_w, mode="drop")
+                .reshape(Rl, W)
+            )
+            has = (
+                has.reshape(-1).at[f_idx].set(f_h, mode="drop")
+                .reshape(Rl, W)
+            )
+            sub = (
+                sub.reshape(-1).at[f_idx].set(f_s, mode="drop")
+                .reshape(Rl, W)
+            )
+            act = (
+                act.reshape(-1).at[f_idx].set(f_a, mode="drop")
+                .reshape(Rl, W)
+            )
+            gets = solve_lanes(
+                wants, has, sub, act, cap, kind, learn, statc,
+                segsum=segsum, segmax=segmax,
+                expand=lambda totals: totals[row_seg][:, None],
+                lanes=lanes,
+            )
+            out = jnp.take(
+                gets, sel_idx, axis=0, mode="clip",
+                indices_are_sorted=True,
+            ).astype(out_dtype)
+            return wants, gets, sub, act, out[None]
+
+        rowk = P(axes, None)
+        row = P(axes)
+        rep = P()
+        mapped = shard_map(
+            body,
+            mesh=self._mesh,
+            in_specs=(
+                rowk, rowk, rowk, rowk,  # tables
+                row,  # row_seg (local block)
+                row,  # fused uint8 buffer [n_dev, B]
+                rep, rep, rep, rep,  # per-segment config
+            ),
+            out_specs=(rowk, rowk, rowk, rowk, P(axes, None, None)),
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(*args):
+            return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
+
     # -- phases -------------------------------------------------------
 
     def _drain(self, ph: PhaseRecorder):
@@ -527,26 +760,53 @@ class WideResidentSolver(TickEngineBase):
             padded(f_w, Df, 0),
             padded(f_h, Df, 0),
             padded(f_s, Df, 0),
-            padded(f_a, Df, False),
             sel_pad.astype(np.int32),
+            # Active flags last: raw uint8 bytes carry no alignment
+            # constraint in the fused buffer layout (_fused_layout).
+            padded(f_a, Df, False),
         )
-        ph.lap("staging")
-        put = self._put
-        tick = self._tick_fn(Dw, Df, Sb, lanes)
-        staged = tuple(put(b) for b in host_blocks)
-        ph.lap("upload")
         cfg = self._config
-        (
-            self._wants, self._has, self._sub, self._act, out
-        ) = tick(
-            self._wants, self._has, self._sub, self._act,
-            *staged,
-            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-        )
         from doorman_tpu.utils.transfer import start_download
 
-        out = start_download(out)
-        ph.lap("solve")
+        if self._fused:
+            # One-launch fused wide tick: all eight staged blocks in
+            # one uint8 buffer, one placement, one launch, one download
+            # stream (see ResidentDenseSolver._launch's fused tail).
+            use_bf16 = w_val_block.dtype != self._dtype
+            buf = np.concatenate(
+                [np.ascontiguousarray(b).view(np.uint8).ravel()
+                 for b in host_blocks]
+            )
+            ph.lap("staging")
+            tick = self._tick_fn_fused(Dw, Df, Sb, lanes, use_bf16)
+            buf_d = self._put(buf)
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act, buf_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+            count_launch()
+            out = start_download(out, chunks=1)
+            ph.lap("fused")
+        else:
+            ph.lap("staging")
+            put = self._put
+            tick = self._tick_fn(Dw, Df, Sb, lanes)
+            w_i_d, w_v_d, f_i_d, f_w_d, f_h_d, f_s_d, sel_d, f_a_d = (
+                tuple(put(b) for b in host_blocks)
+            )
+            ph.lap("upload")
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act,
+                w_i_d, w_v_d, f_i_d, f_w_d, f_h_d, f_s_d, f_a_d, sel_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+            count_launch()
+            out = start_download(out)
+            ph.lap("solve")
         return TickHandle(
             out=out,
             sel_rows=sel,
@@ -615,6 +875,23 @@ class WideResidentSolver(TickEngineBase):
         f_idx_b = f_idx_b.astype(idt)
         sel_b = pad_shard_indices(counts_sel, Sb, sel_l).astype(np.int32)
         lanes = self._config.lanes()
+        fused = self._fused
+        if fused:
+            # Fused upload (see ResidentDenseSolver._stage_mesh): one
+            # [n_dev, B] uint8 buffer, each shard's slice carrying its
+            # eight staged blocks back to back in _fused_layout order.
+            n_dev_ax = w_idx_b.shape[0]
+            buf_host = np.concatenate(
+                [
+                    np.ascontiguousarray(b)
+                    .view(np.uint8).reshape(n_dev_ax, -1)
+                    for b in (
+                        w_idx_b, w_val_b, f_idx_b, f_w_b, f_h_b,
+                        f_s_b, sel_b, f_a_b,
+                    )
+                ],
+                axis=1,
+            )
         ph.lap("staging")
 
         itemsize = self._dtype.itemsize
@@ -630,22 +907,38 @@ class WideResidentSolver(TickEngineBase):
             counts_sel * W * np.dtype(self._out_dtype).itemsize,
         )
         put = self._put_rows
-        tick = self._tick_fn_mesh(Dw, Df, Sb, lanes)
-        staged = (
-            put(w_idx_b), put(w_val_b), put(f_idx_b), put(f_w_b),
-            put(f_h_b), put(f_s_b), put(f_a_b), put(sel_b),
-        )
-        ph.lap("upload")
         cfg = self._config
-        (
-            self._wants, self._has, self._sub, self._act, out
-        ) = tick(
-            self._wants, self._has, self._sub, self._act,
-            self._row_seg_d, *staged,
-            cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-        )
-        out = start_sharded_download(out)
-        ph.lap("solve")
+        if fused:
+            use_bf16 = w_val_b.dtype != self._dtype
+            tick = self._tick_fn_mesh_fused(Dw, Df, Sb, lanes, use_bf16)
+            buf_d = put(buf_host)
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act,
+                self._row_seg_d, buf_d,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+            count_launch()
+            out = start_sharded_download(out)
+            ph.lap("fused")
+        else:
+            tick = self._tick_fn_mesh(Dw, Df, Sb, lanes)
+            staged = (
+                put(w_idx_b), put(w_val_b), put(f_idx_b), put(f_w_b),
+                put(f_h_b), put(f_s_b), put(f_a_b), put(sel_b),
+            )
+            ph.lap("upload")
+            (
+                self._wants, self._has, self._sub, self._act, out
+            ) = tick(
+                self._wants, self._has, self._sub, self._act,
+                self._row_seg_d, *staged,
+                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            )
+            count_launch()
+            out = start_sharded_download(out)
+            ph.lap("solve")
         return TickHandle(
             out=out,
             sel_rows=sel,

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from doorman_tpu.utils import dispatch as dispatch_mod
+
 
 def split_for_download(
     arr, *, chunks: "int | None" = None, min_bytes: int = 1 << 17
@@ -29,17 +31,30 @@ def split_for_download(
     ndim = getattr(arr, "ndim", 0)
     if chunks is None:
         chunks = int(min(8, max(2, nbytes >> 18)))
+    if chunks <= 1:
+        # Single-stream download (the fused tick's shape): no slice op
+        # at all — the array itself is the one part.
+        return [arr]
     if ndim < 1 or nbytes < min_bytes or arr.shape[0] < chunks:
         return [arr]
     bounds = np.linspace(0, arr.shape[0], chunks + 1).astype(int)
-    return [arr[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+    parts = [arr[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+    # Each split slice is its own device op (see docstring): the
+    # overlap's dispatch cost, counted so the fused tick's single-
+    # stream download shows up as fewer dispatches, not just a claim.
+    dispatch_mod.count_dispatch(len(parts))
+    return parts
 
 
 def land_parts(parts: list) -> np.ndarray:
     """Land `split_for_download` parts into one contiguous ndarray
-    (preallocated — no per-part concatenate copy)."""
+    (preallocated — no per-part concatenate copy). Every part landed
+    is one device->host sync in the dispatch accounting
+    (utils.dispatch) — the chokepoint the fused-tick `host_syncs`
+    number reads."""
     import jax
 
+    dispatch_mod.count_host_sync(len(parts))
     if len(parts) == 1:
         return jax.device_get(parts[0])
     lead = sum(int(p.shape[0]) for p in parts)

@@ -178,9 +178,15 @@ def test_dispatch_collect_contract(path):
     else:
         assert set(PHASES) <= set(eng.phase_s)
         # The engine laps real phases (staging is host-side assembly,
-        # split from the device placement "upload").
+        # split from the device window). Fused mode (the default) runs
+        # the device window as ONE "fused" lap; round-trip mode keeps
+        # the separate "upload" placement lap.
         assert eng.phase_s["staging"] > 0.0
-        assert eng.phase_s["upload"] > 0.0
+        if eng.fused_tick:
+            assert eng.phase_s["fused"] > 0.0
+            assert eng.phase_s["upload"] == 0.0
+        else:
+            assert eng.phase_s["upload"] > 0.0
 
 
 @pytest.mark.parametrize("path", ("resident", "wide"))

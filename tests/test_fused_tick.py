@@ -1,0 +1,418 @@
+"""Fused device-resident tick: byte-identity, dispatch accounting, and
+the fused pallas kernel's interpret-mode parity.
+
+The fused tick (solver/resident.py / resident_wide.py fused tails) runs
+one packed staged upload + ONE staging->solve->delta launch + one
+download stream per tick instead of a device dispatch per staged block.
+This suite pins the three claims that make it shippable:
+
+  * byte-identity: fused vs round-trip stores are IDENTICAL over churn
+    that mixes bf16-exact and non-exact wants, across all four resident
+    paths (narrow/wide x single-device/mesh — the mesh legs run under
+    the forced 8-device CPU platform), with the delta-tracking
+    changed-rid stream (what the streaming push fans out from) equal
+    too — so the push sequence cannot differ;
+  * accounting: the per-tick `dispatches`/`host_syncs` counters through
+    the utils.dispatch chokepoints drop from 5/2 to 2/1 on a
+    steady-state tracked tick (the bench-scale reduction is larger —
+    the round-trip download splits into several counted streams);
+  * kernels: pallas_dense.fused_tick_pallas (solve + delta compare +
+    prev update in one VMEM pass) matches solve_dense + the host delta
+    reference bit-for-bit in interpret mode over every AlgoKind lane,
+    including the compact water-fill restriction and learning-mode
+    replay; the band-masked priority kernel parity rides
+    tests/test_pallas_priority.py.
+
+Donation-reuse regression: every parity run steps the fused executable
+repeatedly through the `x = f(x)` rebind pattern — a donation bug dies
+loudly (XLA refuses a donated buffer's reuse), so the multi-step runs
+ARE the regression test.
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.parallel import make_mesh
+from doorman_tpu.solver.engine import PHASES
+from doorman_tpu.solver.resident import ResidentDenseSolver
+from doorman_tpu.solver.resident_wide import WideResidentSolver
+from doorman_tpu.utils import dispatch as dispatch_mod
+from tests.test_engine import assert_store_parity, conformance_churn
+from tests.test_resident_solver import all_leases, make_world
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+FUSED_PATHS = ("resident", "resident_mesh", "wide", "wide_mesh")
+
+
+def _make(path, engine, clock, fused):
+    mesh = make_mesh() if path.endswith("_mesh") else None
+    if path.startswith("resident"):
+        return ResidentDenseSolver(
+            engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+            mesh=mesh, fused=fused,
+        )
+    return WideResidentSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+        chunk_width=8, mesh=mesh, fused=fused,
+    )
+
+
+@pytest.mark.parametrize("path", FUSED_PATHS)
+def test_fused_vs_roundtrip_byte_identity(path):
+    """The load-bearing pin: one churn stream (mixing bf16-exact and
+    non-exact wants — both fused buffer encodings compile and run),
+    fused and round-trip solvers compared store-for-store every tick.
+    Narrow paths additionally run delta tracking and must emit the SAME
+    changed-rid stream (the streaming push's input — equal rids means
+    the push sequence cannot differ), and the repeated fused steps are
+    the donation-reuse (`x = f(x)` rebind) regression."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    plain = _make(path, eng_a, clock, fused=False)
+    fused = _make(path, eng_b, clock, fused=True)
+    assert fused.fused_tick and not plain.fused_tick
+    track = path.startswith("resident")
+    if track:
+        assert plain.enable_delta_tracking()
+        assert fused.enable_delta_tracking()
+    rng_a, rng_b = (np.random.default_rng(17) for _ in range(2))
+    for step in range(8):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        if step == 4:
+            # Learning-mode flip mid-run: the config epoch bump drives
+            # the full-delivery path through the fused executable too.
+            res_a[2].learning_mode_end = t[0] + 2.5
+            res_b[2].learning_mode_end = t[0] + 2.5
+        epoch = 1 if step >= 4 else 0
+        plain.step(res_a, epoch)
+        fused.step(res_b, epoch)
+        ref, got = all_leases(res_a), all_leases(res_b)
+        # Fused vs round-trip is exact on every path (same executable
+        # math, only the transfer packing differs) — the wide paths'
+        # reassociation tolerance applies vs the BatchSolver, not here.
+        assert ref.keys() == got.keys(), f"{path} step {step}"
+        for key in ref:
+            assert got[key] == ref[key], (
+                f"{path} step {step} lease {key}: "
+                f"{got[key]} != {ref[key]}"
+            )
+        if track:
+            assert (
+                sorted(plain.take_changed_rids())
+                == sorted(fused.take_changed_rids())
+            ), f"{path} step {step}: changed-rid streams diverged"
+        t[0] += 1.0
+    # Both bf16 encodings actually compiled (the churn alternates
+    # exact/non-exact wants). The bf16 flag sits last in the narrow
+    # fused key and before the index dtype in the wide fused key.
+    bf_at = -1 if path.startswith("resident") else 5
+    fused_keys = [k for k in fused._tick_fns if k[0].startswith("fused")]
+    assert {k[bf_at] for k in fused_keys} == {True, False}, fused_keys
+
+
+def test_fused_matches_batch_ground_truth():
+    """Fused narrow stores also match the BatchSolver oracle world (the
+    conformance suite's ground truth), so fusion cannot drift from the
+    reference math even if both resident paths drifted together."""
+    from doorman_tpu.solver.batch import BatchSolver
+    from doorman_tpu.solver.engine import BatchTickAdapter
+
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    batch = BatchTickAdapter(BatchSolver(dtype=np.float64, clock=clock))
+    fused = _make("resident", eng_b, clock, fused=True)
+    rng_a, rng_b = (np.random.default_rng(23) for _ in range(2))
+    for step in range(6):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        batch.step(res_a, 0)
+        fused.step(res_b, 0)
+        assert_store_parity(
+            all_leases(res_a), all_leases(res_b), "resident",
+            f"step {step}",
+        )
+        t[0] += 1.0
+
+
+def test_fused_phase_vocabulary():
+    """Fused ticks lap the registered "fused" phase (the single
+    placement + launch + download kickoff) instead of upload/solve;
+    the round-trip mode keeps upload/solve and never laps "fused"."""
+    assert "fused" in PHASES
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    plain = _make("resident", eng_a, clock, fused=False)
+    fused = _make("resident", eng_b, clock, fused=True)
+    for solver, res in ((plain, res_a), (fused, res_b)):
+        for step in range(2):
+            res[0].store.assign(
+                "c0_0", 60.0, 5.0, res[0].store.get("c0_0").has,
+                5.0 + step, 1,
+            )
+            solver.step(res, 0)
+            t[0] += 1.0
+    assert fused.phase_s["fused"] > 0.0
+    assert fused.phase_s["upload"] == 0.0
+    assert fused.phase_s["solve"] == 0.0
+    assert plain.phase_s["fused"] == 0.0
+    assert plain.phase_s["upload"] > 0.0
+    assert plain.phase_s["solve"] > 0.0
+
+
+def test_dispatch_accounting_steady_tick():
+    """The accounting chokepoints see exactly the documented per-tick
+    shape at test scale: a steady-state tracked round-trip tick costs
+    4 staged placements + 1 launch = 5 dispatches and 2 host syncs
+    (grant slab + changed mask); the fused tick costs 1 placement +
+    1 launch = 2 dispatches and 1 sync (mask packed into the slab).
+    At bench scale the round-trip download additionally splits into
+    several counted streams, so the reduction there is larger."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    counts = {}
+    for fused in (False, True):
+        engine, resources = make_world(clock)
+        solver = _make("resident", engine, clock, fused=fused)
+        solver.enable_delta_tracking()
+        rng = np.random.default_rng(5)
+        for step in range(3):  # build + settle both executables
+            conformance_churn(resources, step, rng)
+            solver.step(resources, 0)
+            t[0] += 1.0
+        conformance_churn(resources, 3, rng)
+        before = dispatch_mod.snapshot()
+        solver.step(resources, 0)
+        counts[fused] = dispatch_mod.delta(before)
+        t[0] += 1.0
+    assert counts[True]["dispatches"] == 2, counts
+    assert counts[False]["dispatches"] == 5, counts
+    assert counts[True]["host_syncs"] == 1, counts
+    assert counts[False]["host_syncs"] == 2, counts
+    # The acceptance direction, stated as a ratio: >= 2.5x at test
+    # scale, >= 3x at bench scale where the split download counts.
+    assert (
+        counts[False]["dispatches"] >= 2.5 * counts[True]["dispatches"]
+    )
+
+
+def test_fused_toggle_rebuilds_executables():
+    """Flipping fused_tick at runtime drops the cached executables and
+    both modes keep producing identical stores (triage flow: flip a
+    live server to round-trip mode without a restart)."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_world(clock)
+    eng_b, res_b = make_world(clock)
+    ref = _make("resident", eng_a, clock, fused=False)
+    toggled = _make("resident", eng_b, clock, fused=True)
+    rng_a, rng_b = (np.random.default_rng(31) for _ in range(2))
+    for step in range(6):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        if step == 3:
+            toggled.fused_tick = False
+        ref.step(res_a, 0)
+        toggled.step(res_b, 0)
+        ref_rows, got_rows = all_leases(res_a), all_leases(res_b)
+        assert ref_rows == got_rows, f"step {step}"
+        t[0] += 1.0
+    assert not toggled.fused_tick
+
+
+# ----------------------------------------------------------------------
+# Fused pallas kernel (interpret mode — the CPU parity path)
+# ----------------------------------------------------------------------
+
+
+def _random_batch(rng, R, K, kinds, dtype=np.float32):
+    import jax.numpy as jnp
+
+    from doorman_tpu.solver.dense import DenseBatch
+
+    return DenseBatch(
+        wants=jnp.asarray(rng.integers(0, 60, (R, K)).astype(dtype)),
+        has=jnp.asarray(rng.integers(0, 25, (R, K)).astype(dtype)),
+        subclients=jnp.asarray(
+            rng.integers(1, 4, (R, K)).astype(dtype)
+        ),
+        active=jnp.asarray(rng.random((R, K)) < 0.8),
+        capacity=jnp.asarray(
+            rng.integers(10, 400, R).astype(dtype)
+        ),
+        algo_kind=jnp.asarray(kinds.astype(np.int32)),
+        learning=jnp.asarray(rng.random(R) < 0.2),
+        static_capacity=jnp.asarray(
+            rng.integers(1, 12, R).astype(dtype)
+        ),
+    )
+
+
+def test_fused_pallas_kernel_all_lanes_parity():
+    """fused_tick_pallas over every AlgoKind lane (incl. learning
+    replay): its grants are BIT-identical to solve_dense_pallas (the
+    unfused TPU solve it replaces — same kernel body, so the fused TPU
+    tick cannot move a grant), within the established kernel-vs-XLA
+    tolerance of solve_dense (the lane-padded f32 reduction order
+    differs, exactly as tests/test_pallas_dense.py pins), and the
+    delta/prev outputs are bit-consistent with its own grants:
+    changed = delivered AND any-lane moved, prev advances delivered
+    rows only."""
+    import jax.numpy as jnp
+
+    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.solver.dense import solve_dense
+    from doorman_tpu.solver.pallas_dense import (
+        fused_tick_pallas,
+        solve_dense_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    R, K = 40, 24
+    kinds = rng.choice(
+        [
+            int(k)
+            for k in (
+                AlgoKind.NO_ALGORITHM,
+                AlgoKind.STATIC,
+                AlgoKind.PROPORTIONAL_SHARE,
+                AlgoKind.FAIR_SHARE,
+                AlgoKind.PROPORTIONAL_TOPUP,
+            )
+        ],
+        R,
+    )
+    batch = _random_batch(rng, R, K, kinds)
+    prev = jnp.asarray(rng.integers(0, 30, (R, K)).astype(np.float32))
+    delivered = jnp.asarray((rng.random(R) < 0.5).astype(np.float32))
+
+    gets, prev_new, changed = fused_tick_pallas(
+        batch, prev, delivered, interpret=True
+    )
+    gets = np.asarray(gets)
+    # Bit-identical to the unfused pallas solve it replaces.
+    np.testing.assert_array_equal(
+        gets, np.asarray(solve_dense_pallas(batch, interpret=True))
+    )
+    # Within the established kernel-vs-XLA tolerance of solve_dense.
+    np.testing.assert_allclose(
+        gets, np.asarray(solve_dense(batch)), rtol=1e-5, atol=1e-4
+    )
+    deliv = np.asarray(delivered) > 0
+    exp_changed = deliv & (gets != np.asarray(prev)).any(axis=1)
+    np.testing.assert_array_equal(np.asarray(changed), exp_changed)
+    np.testing.assert_array_equal(
+        np.asarray(prev_new),
+        np.where(deliv[:, None], gets, np.asarray(prev)),
+    )
+
+
+def test_fused_pallas_kernel_matches_compact_waterfill():
+    """FAIR_SHARE rows through the fused kernel agree with the compact
+    water-fill restriction (solve_dense with fair_rows — the
+    gather->bisect->scatter round trip the fused TPU tick replaces)
+    within the established kernel-vs-XLA tolerance, and exactly with
+    the unfused pallas kernel."""
+    import jax.numpy as jnp
+
+    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.solver.dense import solve_dense
+    from doorman_tpu.solver.pallas_dense import (
+        fused_tick_pallas,
+        solve_dense_pallas,
+    )
+
+    rng = np.random.default_rng(13)
+    R, K = 32, 16
+    kinds = np.full(R, int(AlgoKind.PROPORTIONAL_SHARE))
+    fair = rng.choice(R, 10, replace=False)
+    kinds[fair] = int(AlgoKind.FAIR_SHARE)
+    batch = _random_batch(rng, R, K, kinds)
+    fair_rows = jnp.asarray(
+        np.resize(np.sort(fair), 16).astype(np.int32)
+    )
+    compact = np.asarray(
+        solve_dense(
+            batch,
+            lanes=frozenset(int(k) for k in np.unique(kinds)),
+            fair_rows=fair_rows,
+        )
+    )
+    prev = jnp.zeros((R, K), jnp.float32)
+    delivered = jnp.ones(R, jnp.float32)
+    gets, _, changed = fused_tick_pallas(
+        batch, prev, delivered, interpret=True
+    )
+    gets = np.asarray(gets)
+    np.testing.assert_array_equal(
+        gets, np.asarray(solve_dense_pallas(batch, interpret=True))
+    )
+    np.testing.assert_allclose(gets, compact, rtol=1e-5, atol=1e-4)
+    # Full delivery against a zero prev: changed wherever grants are
+    # nonzero.
+    np.testing.assert_array_equal(
+        np.asarray(changed), (gets != 0).any(axis=1)
+    )
+
+
+def test_fused_pallas_kernel_bf16_exact_and_not():
+    """Both fused-buffer wants encodings feed the same kernel output:
+    bf16-exact wants (small integers) and non-exact wants (thirds)
+    solve identically whether the host shipped them compact or full
+    width — the cast back is the identity exactly when bf16_exact said
+    so."""
+    import jax.numpy as jnp
+    from ml_dtypes import bfloat16
+
+    from doorman_tpu.algorithms.kinds import AlgoKind
+    from doorman_tpu.solver.engine import bf16_exact
+    from doorman_tpu.solver.pallas_dense import fused_tick_pallas
+
+    rng = np.random.default_rng(19)
+    R, K = 16, 8
+    kinds = np.full(R, int(AlgoKind.PROPORTIONAL_SHARE))
+    exact = rng.integers(0, 100, (R, K)).astype(np.float32)
+    assert bf16_exact(exact)
+    inexact = exact + np.float32(1.0 / 3.0)
+    assert not bf16_exact(inexact)
+    for wants, is_exact in ((exact, True), (inexact, False)):
+        shipped = (
+            wants.astype(bfloat16).astype(np.float32)
+            if is_exact
+            else wants
+        )
+        np.testing.assert_array_equal(shipped, wants) if is_exact else None
+        batch = _random_batch(rng, R, K, kinds)
+        batch = type(batch)(
+            wants=jnp.asarray(shipped),
+            has=batch.has,
+            subclients=batch.subclients,
+            active=batch.active,
+            capacity=batch.capacity,
+            algo_kind=batch.algo_kind,
+            learning=batch.learning,
+            static_capacity=batch.static_capacity,
+        )
+        gets, _, _ = fused_tick_pallas(
+            batch,
+            jnp.zeros((R, K), jnp.float32),
+            jnp.ones(R, jnp.float32),
+            interpret=True,
+        )
+        from doorman_tpu.solver.dense import solve_dense
+
+        np.testing.assert_array_equal(
+            np.asarray(gets), np.asarray(solve_dense(batch))
+        )

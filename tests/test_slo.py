@@ -175,6 +175,28 @@ def test_bench_verdict_applies_to_wall_ms_rows():
     assert slo.bench_verdict({"metric": "y_wall_ms", "value": "n/a"}) is None
 
 
+def test_tpu_tick_budget_is_a_standing_spec():
+    """The <10 ms one-chip target (ROADMAP "Sub-10 ms TPU tick") is a
+    standing SloSpec: accelerator rounds report pass/fail
+    automatically, CPU-fallback rounds yield an HONEST no_data verdict
+    (never a fail that would poison the trajectory deltas) while still
+    recording the CPU number in the detail."""
+    spec = slo.tpu_tick_budget_spec()
+    assert spec.target == slo.TPU_TICK_BUDGET_MS == 10.0
+    assert spec.kind == "max"
+
+    v = slo.tpu_tick_verdict(7.5, cpu_fallback=False)
+    assert v["status"] == "pass" and v["margin"] == 2.5
+
+    v = slo.tpu_tick_verdict(12.0, cpu_fallback=False)
+    assert v["status"] == "fail"
+
+    v = slo.tpu_tick_verdict(44.0, cpu_fallback=True)
+    assert v["status"] == "no_data"
+    assert v["observed"] is None
+    assert v["detail"]["cpu_p50_ms"] == 44.0
+
+
 # ----------------------------------------------------------------------
 # Trajectory comparator
 # ----------------------------------------------------------------------

@@ -209,8 +209,11 @@ def test_loopback_trace_propagation_and_debug_pages(tracer):
 
     native_store = native.native_available()
     component = "resident" if native_store else "batch"
+    # The resident path runs the fused one-launch tick by default: the
+    # device window is one "fused" phase span (round-trip mode would
+    # emit upload/solve — see tests/test_fused_tick.py).
     phases = (
-        ("upload", "solve", "download", "apply")
+        ("fused", "download", "apply")
         if native_store
         else ("pack", "solve", "apply")
     )
@@ -313,7 +316,7 @@ def test_loopback_trace_propagation_and_debug_pages(tracer):
     doc = json.loads(chrome)
     names = {e["name"] for e in doc["traceEvents"]}
     assert {"client.refresh", "server.GetCapacity", "server.tick",
-            "solve"} <= names
+            "fused" if native_store else "solve"} <= names
 
 
 def test_direct_handler_call_tolerates_no_context(tracer):
@@ -342,9 +345,11 @@ def test_direct_handler_call_tolerates_no_context(tracer):
 
 
 def test_resident_phase_spans_and_histograms(tracer):
-    """The device-resident tick path emits upload/solve/download/apply
-    (and the rest) as spans nested under the ambient tick span, and as
-    per-phase histograms in the default registry."""
+    """The device-resident tick path emits its phase laps (the fused
+    device window by default, upload/solve in round-trip mode) as
+    spans nested under the ambient tick span, and as per-phase
+    histograms in the default registry. Both modes step so both
+    vocabularies land."""
     from doorman_tpu import native
 
     if not native.native_available():
@@ -370,22 +375,30 @@ def test_resident_phase_spans_and_histograms(tracer):
         engine, dtype=np.float64, rotate_ticks=1
     )
     with tracer.span("server.tick", cat="tick") as tick:
-        solver.step([res])
+        solver.step([res])  # fused (default): one "fused" device lap
+    solver.fused_tick = False
+    res.store.assign("c0", 60.0, 5.0, 0.0, 15.0, 1)
+    with tracer.span("server.tick", cat="tick") as tick2:
+        solver.step([res])  # round-trip: upload + solve laps
     by_name = {}
     for ev in tracer.snapshot():
         by_name.setdefault(ev.name, []).append(ev)
-    for phase in ("sweep", "drain", "pack", "upload", "solve",
-                  "download", "apply", "rebuild"):
+    for phase, parent in (
+        ("sweep", tick), ("drain", tick), ("pack", tick),
+        ("fused", tick), ("download", tick), ("apply", tick),
+        ("rebuild", tick), ("upload", tick2), ("solve", tick2),
+    ):
         assert phase in by_name, phase
         ev = by_name[phase][0]
-        assert ev.parent_id == tick.span_id, phase
+        assert ev.parent_id == parent.span_id, phase
         assert ev.cat == "phase:resident"
     assert tracer.open_spans() == []
     text = default_registry().expose()
-    assert (
-        'doorman_tick_phase_seconds_count{component="resident",'
-        'phase="upload"}' in text
-    )
+    for phase in ("fused", "upload"):
+        assert (
+            'doorman_tick_phase_seconds_count{component="resident",'
+            f'phase="{phase}"}}' in text
+        )
 
 
 # ----------------------------------------------------------------------
