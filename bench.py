@@ -444,12 +444,16 @@ def bench_server_tick() -> None:
 
     R, C = NUM_RESOURCES, CLIENTS_PER_RESOURCE
 
-    def run(fused: bool) -> dict:
+    def run(fused: bool, scoped: bool = False,
+            churn_res: int = CHURN_RESOURCES) -> dict:
         """One full build + warmup + measured window; a fresh engine
-        and rng per variant, so both paths start from byte-identical
-        stores and replay the same churn stream. `fused` turns on the
-        WHOLE fused pipeline: fused-tick mode (one launch per tick)
-        plus admission-fused staging."""
+        and rng per variant, so every path starts from byte-identical
+        stores and replays the same-seeded churn stream. `fused` turns
+        on the WHOLE fused pipeline: fused-tick mode (one launch per
+        tick) plus admission-fused staging. `scoped` additionally
+        scopes each tick's solve to the dirty rows + convergence
+        frontier (the churn-proportional tick); `churn_res` is the
+        resources whose demand changes per tick (the churn tiers)."""
         rng = np.random.default_rng(11)
         engine = native.StoreEngine()
         kind_choices = np.array(
@@ -503,6 +507,7 @@ def bench_server_tick() -> None:
             engine, dtype=dtype, device=device,
             rotate_ticks=1,  # first tick delivers all (oracle check)
             fused=fused,
+            scoped=scoped,
         )
         if fused:
             solver.attach_staging()
@@ -540,11 +545,11 @@ def bench_server_tick() -> None:
         # handlers' store writes land between ticks.
         n_ticks = SERVER_WARMUP + TICKS_SERVER
         churn_rows = [
-            rng.choice(R, CHURN_RESOURCES, replace=False)
+            rng.choice(R, churn_res, replace=False)
             for _ in range(n_ticks)
         ]
         churn_wants = [
-            rng.integers(0, 100, CHURN_RESOURCES * C).astype(np.float64)
+            rng.integers(0, 100, churn_res * C).astype(np.float64)
             for _ in range(n_ticks)
         ]
 
@@ -569,11 +574,14 @@ def bench_server_tick() -> None:
         from doorman_tpu.utils import dispatch as dispatch_mod
 
         tick_ms = []
+        tick_only_ms = []
         churn_ms = []
         handles = []
         phase_mark = {}
         collects_mark = 0
         fused_windows = fused_rows = 0
+        scoped_ticks = full_ticks = 0
+        scoped_rows_sum = 0
         dispatch_mark = dispatch_mod.snapshot()
         phase_samples = [dict(solver.phase_s)]
         for t in range(n_ticks):
@@ -581,6 +589,8 @@ def bench_server_tick() -> None:
                 phase_mark = dict(solver.phase_s)
                 collects_mark = solver.ticks
                 fused_windows = fused_rows = 0
+                scoped_ticks = full_ticks = 0
+                scoped_rows_sum = 0
                 dispatch_mark = dispatch_mod.snapshot()
             t0 = time.perf_counter()
             churn(t)
@@ -588,11 +598,21 @@ def bench_server_tick() -> None:
             handles.append(solver.dispatch(resources))
             fused_windows += solver.last_fused["windows"]
             fused_rows += solver.last_fused["rows"]
+            if solver.last_solve_mode == "scoped":
+                scoped_ticks += 1
+                scoped_rows_sum += solver.last_scope["rows"]
+            else:
+                full_ticks += 1
             if len(handles) >= PIPELINE_DEPTH_SERVER:
                 solver.collect(handles.pop(0))
             t2 = time.perf_counter()
             churn_ms.append((t1 - t0) * 1000.0)
             tick_ms.append((t2 - t0) * 1000.0)
+            # Dispatch+collect only (the churn writer excluded): the
+            # series the churn-proportionality SLO fits — the writer's
+            # cost is the CLIENT workload and scales with churn by
+            # definition; the tick's solve cost is the claim.
+            tick_only_ms.append((t2 - t1) * 1000.0)
             phase_samples.append(dict(solver.phase_s))
         t0 = time.perf_counter()
         for h in handles:
@@ -621,10 +641,19 @@ def bench_server_tick() -> None:
         )
         return {
             "timed": timed,
+            "tick_only": sorted(
+                t + drain_ms / n_ticks
+                for t in tick_only_ms[SERVER_WARMUP:]
+            ),
             "phases": phases,
             "per_tick": phase_deltas_ms(phase_samples)[SERVER_WARMUP:],
             "fused_windows": fused_windows,
             "fused_rows": fused_rows,
+            "scoped_ticks": scoped_ticks,
+            "full_ticks": full_ticks,
+            "scoped_rows_per_tick": round(
+                scoped_rows_sum / max(scoped_ticks, 1), 1
+            ),
             "dispatches_per_tick": round(
                 dispatch_delta["dispatches"] / TICKS_SERVER, 3
             ),
@@ -721,6 +750,179 @@ def bench_server_tick() -> None:
         fused_row,
         artifact_extra={
             "phase_ms_per_tick": fused_run["per_tick"],
+        },
+    )
+
+    # ---- scoped churn tiers: tick cost follows churn, not table size.
+    # One scoped run per churn tier (--churn), same build + seeded
+    # stream discipline as above. Tier rows report the full headline
+    # semantics (churn writer included) PLUS tick_only_* (dispatch +
+    # collect, writer excluded) — the series the churn-proportionality
+    # SLO fits, since the writer's cost scales with churn by
+    # definition. The HEADLINE tier (1% churn, the production steady
+    # state) is emitted LAST as
+    # server_tick_1m_leases_native_store_scoped_wall_ms; the worst-case
+    # pin measures an UNscoped full solve at the 100% tier so "the
+    # worst case never regresses" compares like against like
+    # (doc/bench.md "Churn tiers").
+    headline_frac = SCOPED_HEADLINE_CHURN
+    tiers = {}
+    for frac in SCOPED_CHURN_TIERS:
+        churn_res = max(1, min(R, int(round(R * frac))))
+        tiers[frac] = run(fused=True, scoped=True, churn_res=churn_res)
+        tiers[frac]["churn_res"] = churn_res
+    full100 = run(
+        fused=True, scoped=False,
+        churn_res=max(1, min(R, int(round(R * max(SCOPED_CHURN_TIERS))))),
+    )
+
+    def tier_row(frac, data, metric):
+        ttimed = data["timed"]
+        tonly = data["tick_only"]
+        row = {
+            "metric": metric,
+            "value": round(float(np.median(ttimed)), 3),
+            "unit": "ms",
+            "vs_baseline": round(
+                SERVER_TICK_TARGET_MS / float(np.median(ttimed)), 3
+            ),
+            "selection": f"median_of_{TICKS_SERVER}",
+            "churn_fraction": frac,
+            "churn_resources_per_tick": data["churn_res"],
+            "p50_ms": round(float(np.percentile(ttimed, 50)), 3),
+            "p90_ms": round(float(np.percentile(ttimed, 90)), 3),
+            "p99_ms": round(float(np.percentile(ttimed, 99)), 3),
+            "tick_only_p50_ms": round(
+                float(np.percentile(tonly, 50)), 3
+            ),
+            "tick_only_p99_ms": round(
+                float(np.percentile(tonly, 99)), 3
+            ),
+            # Scope shape over the measured window: rows the compact
+            # solve covered per scoped tick, and the scoped/full tick
+            # split (forced-full escalations show here).
+            "scoped_rows_per_tick": data["scoped_rows_per_tick"],
+            "scoped_ticks": data["scoped_ticks"],
+            "full_ticks": data["full_ticks"],
+            "dispatches_per_tick": data["dispatches_per_tick"],
+            "host_syncs_per_tick": data["host_syncs_per_tick"],
+            "pipeline_depth": PIPELINE_DEPTH_SERVER,
+            "rotate_ticks": SERVER_ROTATE_TICKS,
+            "phase_ms": data["phases"],
+        }
+        return row
+
+    def tier_label(frac):
+        pct = frac * 100.0
+        text = (f"{pct:g}").replace(".", "p")
+        return f"churn{text}pct"
+
+    for frac in sorted(tiers):
+        if frac == headline_frac:
+            continue  # the headline tier is the LAST emitted line
+        emit(
+            tier_row(
+                frac, tiers[frac],
+                "server_tick_1m_leases_native_store_scoped_"
+                f"{tier_label(frac)}_wall_ms",
+            ),
+            artifact_extra={
+                "phase_ms_per_tick": tiers[frac]["per_tick"],
+            },
+        )
+
+    # Churn-proportionality verdicts: the log-log slope of tick-only
+    # median vs churn fraction must stay sublinear (< 1.0 — cost
+    # follows churn), and the 100%-churn scoped tier must stay within
+    # noise of the unscoped full solve at the same churn (<= 1.15x —
+    # the worst case never regresses).
+    fracs = sorted(tiers)
+    med_only = {
+        f: float(np.median(tiers[f]["tick_only"])) for f in fracs
+    }
+    exponent = round(
+        float(
+            np.polyfit(
+                np.log([f for f in fracs]),
+                np.log([max(med_only[f], 1e-9) for f in fracs]),
+                1,
+            )[0]
+        ),
+        3,
+    )
+    worst_frac = max(fracs)
+    full100_med = float(np.median(full100["tick_only"]))
+    worst_ratio = round(med_only[worst_frac] / max(full100_med, 1e-9), 3)
+    prop_specs = [
+        slo_mod.SloSpec(
+            name="server_tick_scoped:churn_proportional",
+            kind="max", target=1.0, unit="exponent",
+            source={"type": "scalar", "key": "exponent"},
+            description=(
+                "log-log slope of scoped tick-only median vs churn "
+                "fraction — < 1.0 means tick cost follows churn, "
+                "not table size"
+            ),
+        ),
+        slo_mod.SloSpec(
+            name="server_tick_scoped:worst_case_vs_full",
+            kind="max", target=1.15, unit="ratio",
+            source={"type": "scalar", "key": "worst_ratio"},
+            description=(
+                "100%-churn scoped tick-only median vs the unscoped "
+                "full solve at the same churn — the worst case never "
+                "regresses"
+            ),
+        ),
+    ]
+    prop_verdicts = slo_mod.SloEngine(prop_specs).evaluate(
+        slo_mod.SloInputs(
+            scalars={"exponent": exponent, "worst_ratio": worst_ratio}
+        )
+    )
+    emit({
+        "metric": "server_tick_scoped_churn_proportionality",
+        "value": exponent,
+        "unit": "exponent",
+        "tiers": {
+            str(f): round(med_only[f], 3) for f in fracs
+        },
+        "tiers_wall_ms": {
+            str(f): round(float(np.median(tiers[f]["timed"])), 3)
+            for f in fracs
+        },
+        "worst_ratio_vs_full": worst_ratio,
+        "full_solve_at_worst_tier_ms": round(full100_med, 3),
+        "slo": prop_verdicts,
+    })
+
+    # The scoped steady-state tick is the round's HEADLINE (the LAST
+    # emitted line, which the driver parses): 1% churn — a production
+    # steady state — through the full fused + scoped pipeline.
+    head = tier_row(
+        headline_frac, tiers[headline_frac],
+        "server_tick_1m_leases_native_store_scoped_wall_ms",
+    )
+    hp50_only = float(
+        np.percentile(tiers[headline_frac]["tick_only"], 50)
+    )
+    head_verdicts = []
+    budget = slo_mod.bench_verdict(head)
+    if budget is not None:
+        head_verdicts.append(budget)
+    head_verdicts.append(
+        slo_mod.tpu_tick_verdict(
+            hp50_only,
+            cpu_fallback=bool(
+                _CPU_FALLBACK or device.platform == "cpu"
+            ),
+        )
+    )
+    head["slo"] = head_verdicts
+    emit(
+        head,
+        artifact_extra={
+            "phase_ms_per_tick": tiers[headline_frac]["per_tick"],
         },
     )
 
@@ -1960,6 +2162,11 @@ from doorman_tpu.algorithms.tick import (
 # <100 ms per recompute of the full 1M-lease table, measured here
 # end-to-end through the store of record.
 SERVER_TICK_TARGET_MS = 100.0
+# Scoped-solve churn tiers (fraction of resources whose demand changes
+# per tick); override with --churn. The headline tier is the 1% steady
+# state; 100% pins the worst case against the unscoped full solve.
+SCOPED_CHURN_TIERS = (0.001, 0.01, 0.1, 1.0)
+SCOPED_HEADLINE_CHURN = 0.01
 SERVER_ROTATE_TICKS = 16  # grant delivery rides the 16s refresh cadence
 PIPELINE_DEPTH_SERVER = 4
 SERVER_WARMUP = 6
@@ -2354,12 +2561,27 @@ if __name__ == "__main__":
              "measured solve into this directory (xprof/tensorboard)",
     )
     _ap.add_argument(
+        "--churn", default="",
+        help="comma-separated churn fractions for the scoped "
+             "server-tick tiers (e.g. '0.001,0.01,0.1,1.0'; default "
+             "the standing tier set). The 1%% tier — added if missing "
+             "— is the headline scoped row",
+    )
+    _ap.add_argument(
         "--mesh-devices", type=int, default=0,
         help="devices for the mesh-sharded wide bench (0 = all "
              "visible; a diagnostic is emitted when fewer than "
              "max(requested, 2) are available)",
     )
     _args = _ap.parse_args()
+    if _args.churn:
+        _tiers = sorted(
+            {float(x) for x in _args.churn.split(",") if x.strip()}
+            | {SCOPED_HEADLINE_CHURN}
+        )
+        if any(not (0.0 < f <= 1.0) for f in _tiers):
+            _ap.error("--churn fractions must be in (0, 1]")
+        SCOPED_CHURN_TIERS = tuple(_tiers)
     MESH_BENCH_DEVICES = max(_args.mesh_devices, 0)
     if _args.trace:
         _trace_mod.default_tracer().enable()

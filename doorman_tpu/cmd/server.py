@@ -144,6 +144,20 @@ def make_parser() -> argparse.ArgumentParser:
                         "--no-fused-tick keeps the round-trip path "
                         "for baseline measurement and triage, "
                         "doc/operations.md)")
+    p.add_argument("--scoped-solve",
+                   action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="batch mode: scope each fused resident tick "
+                        "to the resource-group closure of the dirty "
+                        "rows plus the not-yet-converged frontier — a "
+                        "compact gather->solve->scatter whose cost "
+                        "follows churn, not table size "
+                        "(byte-identical to the full solve; "
+                        "escalation reasons ride /debug/status and "
+                        "the flight recorder's solve_mode). "
+                        "--no-scoped-solve pins every tick to the "
+                        "full-table solve for triage "
+                        "(doc/operations.md)")
     p.add_argument("--tick-pipeline-depth", type=int, default=3,
                    help="batch mode: resident ticks kept in flight — "
                         "tick N's delivery download overlaps the "
@@ -333,6 +347,7 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         flightrec_dir=args.flightrec_dir or None,
         fuse_admission=args.fuse_admission,
         fused_tick=args.fused_tick,
+        scoped_solve=args.scoped_solve,
         tick_pipeline_depth=args.tick_pipeline_depth,
         stream_push=args.stream_push,
         max_streams_per_band=args.max_streams_per_band,

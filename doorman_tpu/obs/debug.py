@@ -251,6 +251,28 @@ class DebugServer:
                 f" | dispatches: {dispatch.get('dispatches', 0)}"
                 f", host syncs: {dispatch.get('host_syncs', 0)}"
             )
+        scope = st.get("solve_scope") or {}
+        live = {k: v for k, v in scope.items() if v}
+        if live:
+            # The churn-proportional solve at a glance: per path, the
+            # last tick's mode (with the forced-full reason when the
+            # scope escalated) and the compact scope it covered.
+            def _fmt(name, s):
+                mode = s.get("last_mode", "?")
+                if mode == "full" and s.get("last_full_reason"):
+                    mode = f"full:{s['last_full_reason']}"
+                return (
+                    f"{name} {mode}"
+                    f" {s.get('last_scope_rows', 0)}r"
+                    f"/{s.get('last_scope_resources', 0)}res"
+                    f" frontier={s.get('frontier', 0)}"
+                )
+            parts.append(
+                "solve scope: "
+                + ("on" if st.get("scoped_solve") else "OFF")
+                + " | "
+                + ", ".join(_fmt(k, v) for k, v in sorted(live.items()))
+            )
         return f"<p>{' | '.join(parts)}</p>" if parts else ""
 
     def _index_page(self) -> str:

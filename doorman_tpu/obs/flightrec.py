@@ -242,11 +242,17 @@ class FlightRecorder:
             # when the server is a shard — doc/federation.md);
             # dispatches / host_syncs are the per-tick dispatch
             # accounting deltas (utils.dispatch via the server's tick
-            # records) — the fused-tick triage counters.
+            # records) — the fused-tick triage counters;
+            # scoped_rows / scoped_resources are the churn-
+            # proportional solve's per-tick scope (the compact table
+            # the tick actually solved — a counter stuck at the table
+            # size means solve_mode is stuck at full, doc/
+            # operations.md).
             for counter in ("admission_level", "persist_seq",
                             "straddle_capacity", "straddle_updates",
                             "upstream_rpcs", "dispatches",
-                            "host_syncs"):
+                            "host_syncs", "scoped_rows",
+                            "scoped_resources"):
                 v = rec.get(counter)
                 if isinstance(v, (int, float)):
                     events.append({

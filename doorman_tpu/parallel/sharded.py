@@ -263,6 +263,61 @@ def resident_chunk_reduces(
     return segsum, segmax
 
 
+def scoped_chunk_reduces(
+    mesh: Mesh,
+    gpos,
+    row_seg_compact,
+    num_compact_rows: int,
+    num_segments: int,
+):
+    """The SCOPED variant of resident_chunk_reduces: the psum/pmax
+    collective is restricted to the scoped chunks (the churn-
+    proportional wide tick, solver.resident_wide scoped mode).
+
+    Where the full reduce assembles every shard's per-row totals into
+    the global [R] row vector, the scoped reduce assembles each shard's
+    COMPACT per-row totals into the global compact row vector [Cbg] at
+    the host-computed global compact positions `gpos` (traced int32,
+    one per local compact slot; padding slots carry the out-of-range
+    index Cbg and drop). The supports stay disjoint — every global
+    compact position is owned by exactly one shard, every other shard
+    contributes the combine identity — so the psum/pmax is exact, and
+    the segment op runs over the compact row->segment map in global
+    row order: the partial sums of a straddling segment add in exactly
+    the full reduce's order, which keeps scoped totals bit-identical
+    to the full-table reduce for every scoped segment. Traffic: one
+    [Cbg]-sized collective per reduce call instead of [R] — the psum
+    now scales with churn, not table size.
+
+    Call INSIDE the shard_mapped body with the traced per-shard
+    `gpos` / replicated `row_seg_compact` slices. Returns (segsum,
+    segmax) taking the shard-local compact [Cbl, W] lease block and
+    returning replicated [num_segments] totals.
+    """
+    axes = tuple(mesh.axis_names)
+
+    def assemble(local, fill, combine):
+        rows = jnp.full((num_compact_rows,), fill, local.dtype)
+        rows = rows.at[gpos].set(local, mode="drop")
+        return combine(rows, axes)
+
+    def segsum(v):
+        rows = assemble(v.sum(axis=1), 0, jax.lax.psum)
+        return jax.ops.segment_sum(
+            rows, row_seg_compact, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    def segmax(v):
+        rows = assemble(v.max(axis=1), -jnp.inf, jax.lax.pmax)
+        return jax.ops.segment_max(
+            rows, row_seg_compact, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+
+    return segsum, segmax
+
+
 def shard_chunked(mesh: Mesh, batch):
     """Place a ChunkedDenseBatch on the mesh: chunk rows (and row_seg)
     sharded over all mesh axes, padded with inactive rows mapped to the

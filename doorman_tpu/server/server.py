@@ -110,6 +110,7 @@ class CapacityServer(CapacityServicer):
         flightrec_dir: Optional[str] = None,
         fuse_admission: bool = False,
         fused_tick: bool = True,
+        scoped_solve: bool = True,
         tick_pipeline_depth: int = 1,
         stream_push: bool = False,
         max_streams_per_band: int = 0,
@@ -227,6 +228,15 @@ class CapacityServer(CapacityServicer):
         # fused_tick=False keeps the round-trip path for baseline
         # measurement and triage (doc/operations.md).
         self._fused_tick = bool(fused_tick)
+        # Scoped solve for the resident solvers (the default): each
+        # fused tick solves only the resource-group closure of the
+        # dirty set plus the not-yet-converged frontier in a compact
+        # table, byte-identical to the full solve (tests/
+        # test_scoped_solve.py pins it); per-tick `solve_mode`
+        # escalation reasons ride the flight recorder and
+        # /debug/status. scoped_solve=False pins every tick to the
+        # full-table solve for triage (doc/operations.md).
+        self._scoped_solve = bool(scoped_solve)
         # Optional device mesh for the resident solvers: table rows
         # shard across its devices and each tick is a shard_mapped
         # solve (store contents stay bit-identical to the single-device
@@ -614,6 +624,7 @@ class CapacityServer(CapacityServicer):
                 # cadence relative to this server's tick cadence.
                 rotate_ticks=None, tick_interval=self.tick_interval,
                 fused=self._fused_tick,
+                scoped=self._scoped_solve,
             )
             if self._fuse_admission and self._admission is not None:
                 # Admission-fused staging: the coalescer's windows
@@ -648,6 +659,7 @@ class CapacityServer(CapacityServicer):
                 mesh=self._solver_mesh,
                 rotate_ticks=None, tick_interval=self.tick_interval,
                 fused=self._fused_tick,
+                scoped=self._scoped_solve,
             )
             if self.flightrec is not None:
                 self._resident_wide.on_anomaly = self._solver_anomaly
@@ -1272,6 +1284,35 @@ class CapacityServer(CapacityServicer):
             if lf.get("windows") or lf.get("rows"):
                 rec["fused_windows"] = int(lf.get("windows", 0))
                 rec["fused_rows"] = int(lf.get("rows", 0))
+        # Scoped-solve shape of the last resident dispatch(es): which
+        # solve mode ran ("scoped", "full", or "full:<reason>" when an
+        # escalation forced the full table), and the scope the compact
+        # solve covered. Narrow + wide paths fold: a forced-full on
+        # either is the record's mode (escalations must be loud), and
+        # the scope tallies sum.
+        solvers = [
+            s
+            for s in (self._resident, self._resident_wide)
+            if s is not None and s.ticks
+        ]
+        if solvers:
+            forced = [
+                s.last_full_reason
+                for s in solvers
+                if s.last_solve_mode == "full" and s.last_full_reason
+            ]
+            if forced:
+                rec["solve_mode"] = f"full:{forced[0]}"
+            elif any(s.last_solve_mode == "full" for s in solvers):
+                rec["solve_mode"] = "full"
+            else:
+                rec["solve_mode"] = "scoped"
+            rec["scoped_rows"] = sum(
+                int(s.last_scope.get("rows", 0)) for s in solvers
+            )
+            rec["scoped_resources"] = sum(
+                int(s.last_scope.get("resources", 0)) for s in solvers
+            )
         # Dispatch accounting: device dispatches (transfers + launches)
         # and device->host syncs this tick asked of the accelerator,
         # counted through the place()/land_parts chokepoints
@@ -1972,6 +2013,24 @@ class CapacityServer(CapacityServicer):
             # counted chokepoints; per-tick deltas ride the flight
             # recorder as `dispatches`/`host_syncs`).
             "fused_tick": self._fused_tick,
+            # Scoped-solve state per resident path (None: path not
+            # active yet): last solve mode + forced-full reason, the
+            # last compact scope, the host frontier size, and the
+            # cumulative scoped/full tick split — the "solve_mode
+            # stuck at full" triage block (doc/operations.md).
+            "scoped_solve": self._scoped_solve,
+            "solve_scope": {
+                "narrow": (
+                    self._resident.scope_status()
+                    if self._resident is not None
+                    else None
+                ),
+                "wide": (
+                    self._resident_wide.scope_status()
+                    if self._resident_wide is not None
+                    else None
+                ),
+            },
             "dispatch": dispatch_mod.snapshot(),
             # Admission-fused staging counters (None: fusion off or the
             # resident path not active yet); see doc/bench.md.

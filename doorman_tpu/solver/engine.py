@@ -54,6 +54,7 @@ __all__ = [
     "ConfigTable",
     "RotationCursor",
     "FusedStaging",
+    "ScopeTracker",
     "PipelinedTicker",
     "BatchTickAdapter",
     "place",
@@ -63,6 +64,7 @@ __all__ = [
     "bf16_exact",
     "compact_index_dtype",
     "ceil_to",
+    "pow2_bucket",
 ]
 
 # Every tick engine exposes this phase vocabulary (cumulative seconds in
@@ -90,6 +92,18 @@ PHASES = (
     "fused", "aggregate", "match", "download", "apply", "delta",
     "rebuild",
 )
+
+
+def pow2_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (>= minimum). The scoped-solve
+    compact table uses geometric buckets, not multiples: its shape is a
+    jit key and the scope size swings with churn from a handful of rows
+    to the whole table, so the recompile count must stay O(log R)
+    (match.py's incidence extents use the same rule)."""
+    size = max(int(minimum), 1)
+    while size < n:
+        size *= 2
+    return size
 
 
 def ceil_to(n: int, m: int) -> int:
@@ -230,6 +244,22 @@ class TickHandle:
     # slot-major), so the grants and the mask land in one download
     # stream instead of two. 0 = the mask (if any) rides `changed`.
     mask_rows: int = 0
+    # Scoped-solve bookkeeping (solve_mode == "scoped" ticks only): the
+    # scope unit ids this tick solved (narrow: table rows; wide: segment
+    # ids), and the per-unit solve-moved mask — gets != has at the
+    # solve's input, the fixpoint test that retires units from the host
+    # frontier at collect. Single-device fused ticks pack the moved
+    # mask into the slab as `moved_rows` extra rows AFTER the changed
+    # mask; mesh ticks land it as the separate `moved` device array
+    # ([n_dev, Cb] shard blocks sliced by `scope_counts`, or a
+    # replicated [Scb] per-segment mask for the wide mesh). `seq` is
+    # the dispatch sequence number guarding frontier exits against
+    # handles collected after a newer re-dirty (ScopeTracker).
+    scope_ids: "np.ndarray | None" = None
+    moved_rows: int = 0
+    moved: object = None
+    scope_counts: "np.ndarray | None" = None
+    seq: int = 0
 
 
 def idle_handle(now: float) -> TickHandle:
@@ -476,6 +506,86 @@ class FusedStaging:
             }
 
 
+class ScopeTracker:
+    """Host mirror of the not-yet-at-fixpoint unit set (the scoped
+    solve's "frontier"): which solve units (narrow rows / wide
+    segments) may still move if re-solved.
+
+    The scoped tick only solves the units in scope and carries every
+    other unit's resident grants forward untouched, so byte identity
+    with the full solve rests on one invariant: **any unit whose next
+    solve would differ from its resident grants is in the frontier.**
+    The protocol that maintains it:
+
+      entry — a unit enters (or refreshes) at dispatch when the host
+              already knows it may move: its row went dirty, its
+              effective config drifted, or a rebuild / config-epoch
+              tick invalidated host knowledge wholesale (seed_all).
+              Entries are stamped with the dispatch sequence.
+      exit  — a unit leaves only when a collected tick REPORTS it
+              unmoved: the scoped executable compares each scoped
+              unit's fresh solve against its input `has` (the fixpoint
+              test, in the solve dtype) and the mask rides the
+              delivery download. A unit solved-and-unmoved at tick N
+              is at its fixpoint, and a per-unit-independent solve of
+              unchanged inputs is the identity from then on.
+      guard — exits apply only when the unit's entry seq <= the
+              reporting tick's seq: with depth-3 pipelining (and
+              across rebuilds, which renumber unit ids) a stale moved
+              mask must never evict a unit that re-entered after the
+              reporting tick dispatched. Staleness is one-sided by
+              construction: late collects can only keep a unit in
+              scope longer, never drop a moving one.
+
+    Not thread-safe by itself: dispatch and collect run on the tick
+    executor (the server serializes them), matching the engines' other
+    host mirrors.
+    """
+
+    def __init__(self):
+        self._entry: Dict[int, int] = {}  # unit id -> entry seq
+
+    def __len__(self) -> int:
+        return len(self._entry)
+
+    def add(self, ids, seq: int) -> None:
+        """Enter (or refresh) units at dispatch seq `seq`. One dict
+        update, not a per-unit loop: at a 100%-churn tick this runs
+        over every row."""
+        ids = np.asarray(ids).ravel()
+        if len(ids):
+            self._entry.update(
+                zip(ids.tolist(), (seq,) * len(ids))
+            )
+
+    def seed_all(self, n_units: int, seq: int) -> None:
+        """Rebuild / config-epoch tick: any unit may move (and old ids
+        may now name different units) — replace the whole frontier."""
+        self._entry = {i: seq for i in range(int(n_units))}
+
+    def apply_moved(self, ids: np.ndarray, moved: np.ndarray,
+                    seq: int) -> None:
+        """Collect feedback from the tick dispatched at `seq`: retire
+        units reported unmoved, unless re-entered since (seq guard)."""
+        entry = self._entry
+        ids = np.asarray(ids).ravel()
+        moved = np.asarray(moved).ravel()
+        for i in ids[~moved[: len(ids)]].tolist():
+            if entry.get(i, seq + 1) <= seq:
+                del entry[i]
+
+    def ids(self) -> np.ndarray:
+        """The current frontier, sorted (the scope build wants a stable
+        order: sorted unit ids keep mesh shard grouping contiguous and
+        gather hints truthful)."""
+        if not self._entry:
+            return np.zeros(0, np.int64)
+        return np.sort(np.fromiter(self._entry, np.int64, len(self._entry)))
+
+    def clear(self) -> None:
+        self._entry = {}
+
+
 class TickEngineBase:
     """The shared half of a device-resident tick engine.
 
@@ -506,6 +616,7 @@ class TickEngineBase:
         download_dtype=None,
         config_put: "Callable | None" = None,
         fused: bool = True,
+        scoped: bool = True,
     ):
         import jax
 
@@ -565,6 +676,34 @@ class TickEngineBase:
         # .py); `fused=False` keeps the multi-dispatch path for
         # baseline measurement and triage (doc/operations.md).
         self._fused = bool(fused)
+        # Scoped solve (the default): each fused tick solves only the
+        # resource-group closure of the staged dirty set plus the
+        # frontier of units not yet back at their fixpoint
+        # (ScopeTracker), gathered into a pow2-bucketed compact table;
+        # everything else carries forward bit-identically in the
+        # resident grant slab. Any tick whose scope the host cannot
+        # bound (rebuild, config-epoch move, time-driven config drift,
+        # an expiry sweep that removed leases, round-trip mode)
+        # escalates loudly to a full solve — `last_solve_mode` /
+        # `last_full_reason` record the per-tick decision.
+        # scoped=False keeps the always-full solve for triage
+        # (doc/operations.md).
+        self._scoped = bool(scoped)
+        self._scope = ScopeTracker()
+        self._seq = 0  # dispatch sequence (frontier entry/exit guard)
+        self._swept_removed = 0  # leases removed by this tick's sweep
+        self._scope_reset = False  # seed the frontier on the next tick
+        # Scope index buffer cache: the placed device copy of the last
+        # scope vector, reused while the scope bytes are unchanged (the
+        # quiet-tick fixpoint: repeated identical scopes must not
+        # re-place the buffer — pinned by tests/test_scoped_solve.py's
+        # dispatch-count test).
+        self._scope_buf_key: "tuple | None" = None
+        self._scope_buf_dev = None
+        self.last_solve_mode = "full"
+        self.last_full_reason: "str | None" = "startup"
+        self.last_scope: Dict[str, int] = {"rows": 0, "resources": 0}
+        self.solve_modes: Dict[str, int] = {"scoped": 0, "full": 0}
         # Admission-fused staging (narrow path); attach_staging() wires
         # it. None keeps the round-trip pack on every tick.
         self._staging: "FusedStaging | None" = None
@@ -604,6 +743,127 @@ class TickEngineBase:
         if value != self._fused:
             self._fused = value
             self._tick_fns.clear()
+            self._drop_scope_cache()
+
+    @property
+    def scoped_solve(self) -> bool:
+        return self._scoped
+
+    @scoped_solve.setter
+    def scoped_solve(self, value: bool) -> None:
+        """Runtime triage toggle. Turning scoped mode ON re-seeds the
+        whole frontier on the next tick (while it was off, no moved
+        masks flowed, so host knowledge of who is at fixpoint is
+        stale)."""
+        value = bool(value)
+        if value != self._scoped:
+            self._scoped = value
+            self._drop_scope_cache()
+            if value:
+                self._scope_reset = True
+
+    def _drop_scope_cache(self) -> None:
+        self._scope_buf_key = None
+        self._scope_buf_dev = None
+
+    def _place_scope(self, host_arr: np.ndarray, put: Callable):
+        """Place (or reuse) the scope index buffer. An unchanged scope
+        vector — the quiet-tick fixpoint, where the same dirty set (or
+        none) repeats — reuses the resident device copy without a new
+        placement dispatch; any byte change re-places."""
+        key = (host_arr.shape, host_arr.tobytes())
+        if key != self._scope_buf_key or self._scope_buf_dev is None:
+            self._scope_buf_dev = put(host_arr)
+            self._scope_buf_key = key
+        return self._scope_buf_dev
+
+    def scope_status(self) -> dict:
+        """The /debug/status scope block (read from the event loop
+        while ticks run in an executor: plain ints and strings only)."""
+        return {
+            "enabled": self._scoped,
+            "last_mode": self.last_solve_mode,
+            "last_full_reason": self.last_full_reason,
+            "last_scope_rows": int(self.last_scope.get("rows", 0)),
+            "last_scope_resources": int(
+                self.last_scope.get("resources", 0)
+            ),
+            "frontier": len(self._scope),
+            "scoped_ticks": int(self.solve_modes.get("scoped", 0)),
+            "full_ticks": int(self.solve_modes.get("full", 0)),
+        }
+
+    def _scope_for_tick(
+        self,
+        dirty_units: np.ndarray,
+        config_changed: "np.ndarray | None",
+        n_units: int,
+    ) -> "Tuple[np.ndarray | None, str | None]":
+        """Per-tick solve-mode decision (called once per launched tick,
+        AFTER any mid-launch rebuild settled). Returns (scope_ids,
+        forced_full_reason): scope_ids is the sorted unit closure to
+        solve, or None with the reason when this tick must solve the
+        full table. Host-side only — the scope is derived from the
+        mirrored dirty set and the host frontier, never from device
+        data, so every compact shape is host-known (no shape sync).
+
+        Escalation matrix (each reason recorded, doc/design.md):
+          rebuild       — unit ids renumbered; seed_all + full solve
+          config-epoch  — refresh_view returned None (templates
+                          re-read): any unit's config may have moved
+          config-drift  — time-driven capacity/learning flips this
+                          tick (learning-mode end, parent-lease
+                          expiry): the affected units must re-solve
+                          AND deliver under reference same-tick config
+                          freshness; full solve keeps that path on the
+                          one executable that already pins it
+          expiry-sweep  — the sweep removed leases it does not name
+          scope-reset   — scoped mode just re-enabled (stale frontier)
+          round-trip    — fused=False keeps the multi-dispatch
+                          baseline, which has no scoped variant
+          disabled      — --no-scoped-solve triage
+        """
+        self._seq += 1
+        seq = self._seq
+        reason: "str | None" = None
+        if not self._scoped:
+            reason = "disabled"
+        elif not self._fused:
+            reason = "round-trip"
+        elif self._swept_removed:
+            reason = "expiry-sweep"
+        if self._just_rebuilt or config_changed is None:
+            self._scope.seed_all(n_units, seq)
+            if reason is None:
+                reason = "rebuild" if self._just_rebuilt else "config-epoch"
+        elif self._scope_reset:
+            self._scope.seed_all(n_units, seq)
+            if reason is None:
+                reason = "scope-reset"
+        else:
+            if len(dirty_units):
+                self._scope.add(dirty_units, seq)
+            if len(config_changed):
+                cc = np.asarray(config_changed)
+                self._scope.add(cc[cc < n_units], seq)
+                if reason is None:
+                    reason = "config-drift"
+        self._scope_reset = False
+        if reason is not None:
+            self.last_solve_mode = "full"
+            self.last_full_reason = reason
+            self.solve_modes["full"] += 1
+            return None, reason
+        scope = self._scope.ids()
+        # Stale ids past the table (defensive: seed_all covers every
+        # renumbering path, but a frontier must never index out of the
+        # current layout).
+        if len(scope) and scope[-1] >= n_units:
+            scope = scope[scope < n_units]
+        self.last_solve_mode = "scoped"
+        self.last_full_reason = None
+        self.solve_modes["scoped"] += 1
+        return scope, None
 
     def attach_staging(self) -> FusedStaging:
         """Enable admission-fused staging; returns the buffer the
@@ -714,6 +974,10 @@ class TickEngineBase:
 
         now = self._clock()
         removed = self._engine.clean_all(now)
+        # The sweep dirties the rows it touched but does not name them;
+        # a removal therefore escalates this tick to a full solve
+        # (_scope_for_tick's "expiry-sweep" reason).
+        self._swept_removed = int(removed)
         if removed and self._staging is not None:
             # The sweep dirtied rows it does not name: the window-time
             # pack cache can no longer prove freshness — fall back to
@@ -778,18 +1042,30 @@ class TickEngineBase:
         # delivery byte like the grants themselves. Fused ticks land
         # grants AND mask from the one packed slab (see
         # TickHandle.mask_rows); round-trip ticks land them separately.
-        if handle.mask_rows:
+        moved: "np.ndarray | None" = None
+        if handle.mask_rows or handle.moved_rows:
             from doorman_tpu.utils.transfer import land_parts
 
             slab = np.asarray(land_parts(handle.out), np.float64)
-            n_slots = slab.shape[0] - handle.mask_rows
+            n_slots = (
+                slab.shape[0] - handle.mask_rows - handle.moved_rows
+            )
             gets = slab[: handle.n_sel]
             changed = (
-                slab[n_slots:].reshape(-1)[: handle.n_sel] != 0.0
-            )
+                slab[n_slots : n_slots + handle.mask_rows]
+                .reshape(-1)[: handle.n_sel]
+                != 0.0
+            ) if handle.mask_rows else None
+            if handle.moved_rows and handle.scope_ids is not None:
+                moved = (
+                    slab[n_slots + handle.mask_rows :]
+                    .reshape(-1)[: len(handle.scope_ids)]
+                    != 0.0
+                )
         else:
             gets = landed_rows(handle)
             changed = landed_changed(handle)
+            moved = self._landed_moved(handle)
         ph.lap("download")
         applied = self._apply_grants(handle, gets)
         ph.lap("apply")
@@ -805,9 +1081,38 @@ class TickEngineBase:
                     with self._changed_lock:
                         self._changed_rids.update(int(r) for r in rids)
             ph.lap("delta")
+        if moved is not None and handle.scope_ids is not None:
+            # Frontier maintenance: scoped units the solve left at
+            # their fixpoint retire (seq-guarded against re-dirties
+            # that raced this handle through the pipeline). Host numpy
+            # only — the mask landed with the delivery above.
+            self._scope.apply_moved(handle.scope_ids, moved, handle.seq)
         self.ticks += 1
         self.last_tick_seconds = self._clock() - handle.dispatched_at
         return applied
+
+    def _landed_moved(self, handle: TickHandle) -> "np.ndarray | None":
+        """Land a mesh tick's separate solve-moved mask into a host
+        bool array aligned with handle.scope_ids. Narrow mesh ticks
+        carry per-shard [n_dev, Cb] blocks sliced by scope_counts
+        (shard-major order IS the sorted scope order); wide mesh ticks
+        carry one replicated per-segment mask."""
+        if handle.moved is None or handle.scope_ids is None:
+            return None
+        if not isinstance(handle.moved, np.ndarray):
+            # One device->host landing, like the round-trip delta mask.
+            dispatch_mod.count_host_sync()
+        mv = np.asarray(handle.moved)
+        if handle.scope_counts is None:
+            return mv.reshape(-1)[: len(handle.scope_ids)].astype(bool)
+        parts = [
+            mv[d, : int(c)]
+            for d, c in enumerate(handle.scope_counts)
+            if int(c)
+        ]
+        if not parts:
+            return np.zeros(0, bool)
+        return np.concatenate(parts).astype(bool)
 
     def step(
         self, resources: Sequence[Resource], config_epoch: int = 0

@@ -16,12 +16,18 @@ moves only what changed:
             WHICH rows ship. Wants-only blocks ship as bf16 when that
             round-trips exactly (engine.bf16_exact — byte-identical at
             a quarter of the f64 bytes).
-  solve:    the full table every tick (the device solve is cheap; `has`
-            chains on device from the previous tick's grants). The
-            executable is shaped by host config knowledge: absent
-            algorithm lanes are skipped and the FAIR_SHARE water-fill
-            bisection runs only over the fair rows (solver.lanes —
-            byte-identical by construction).
+  solve:    scoped by default to the dirty rows plus the
+            not-yet-converged frontier, gathered into a pow2-bucketed
+            compact table and scattered back into the resident grant
+            slab — byte-identical to the full solve because per-row
+            arithmetic is row-independent (engine.ScopeTracker; any
+            escalation — rebuild, config epoch/drift, expiry sweep —
+            solves the full table loudly). `has` chains on device from
+            the previous tick's grants either way. The executable is
+            shaped by host config knowledge: absent algorithm lanes
+            are skipped and the FAIR_SHARE water-fill bisection runs
+            only over the fair rows (solver.lanes — byte-identical by
+            construction).
   delivery: only the grant rows being DELIVERED this tick — every dirty
             row (so demand changes land in the store within one tick),
             every row whose effective config changed (capacity cut,
@@ -79,6 +85,7 @@ from doorman_tpu.solver.engine import (
     ceil_to,
     count_launch,
     place,
+    pow2_bucket,
 )
 from doorman_tpu.solver.engine import _BF16
 
@@ -114,6 +121,7 @@ class ResidentDenseSolver(TickEngineBase):
         tick_interval: "float | None" = None,
         download_dtype=None,
         fused: bool = True,
+        scoped: bool = True,
     ):
         super().__init__(
             engine,
@@ -125,6 +133,7 @@ class ResidentDenseSolver(TickEngineBase):
             tick_interval=tick_interval,
             download_dtype=download_dtype,
             fused=fused,
+            scoped=scoped,
         )
         self._rows: List[Resource] = []
         self._row_lut = np.full(1, -1, np.int64)
@@ -233,6 +242,7 @@ class ResidentDenseSolver(TickEngineBase):
         self._refresh_config(rows, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
+        self._drop_scope_cache()
 
     def _invalidate_layout(self) -> None:
         # Force a rebuild at the next dispatch so the prev-grants table
@@ -663,6 +673,332 @@ class ResidentDenseSolver(TickEngineBase):
         self._tick_fns[key] = tick
         return tick
 
+    def _tick_fn_fused_scoped(self, Da: int, Df: int, Sb: int, Cb: int,
+                              Fcb: int, lanes: frozenset,
+                              use_bf16: bool):
+        """The scoped fused tick: staging scatters run over the full
+        resident tables exactly as in `_tick_fn_fused`, then the scope
+        rows (a separate cached int32 buffer: [Cb] row indices + [Fcb]
+        compact FAIR_SHARE positions) gather into a pow2-bucketed
+        compact [Cb, K] table, ALL lanes solve over the compact table,
+        and the fresh grants scatter back into the donated resident
+        grant slab — rows outside the scope keep their resident
+        fixpoint grants untouched. Delivery gathers from the updated
+        slab, so the delivered bytes (and the delta compare against
+        the prev table) are byte-identical to the full solve whenever
+        the scope holds every unit not at its fixpoint — the invariant
+        ScopeTracker maintains (doc/design.md "Churn-proportional
+        solve"). The per-scope-row solve-moved mask (gets != input has,
+        in the solve dtype — the fixpoint test) packs into the slab
+        after the changed mask, so the frontier feedback rides the one
+        delivery download. Padding scope slots point at the reserved
+        padding row: duplicates gather identical inputs and scatter
+        identical values."""
+        track = self._track_deltas
+        key = (
+            "fused_scoped", Da, Df, Sb, Cb, Fcb, self._kfill, lanes,
+            track, use_bf16,
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from doorman_tpu.solver.batch import _committed_platform
+        from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+        use_pallas = (
+            _committed_platform(self._wants) == "tpu"
+            and self._dtype == np.float32
+        )
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+        kfill = self._kfill
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        itemsize = int(np.dtype(dtype).itemsize)
+        aw_item = 2 if use_bf16 else itemsize
+        n_idx = (Da + Df + Sb) * 4
+        n_aw = Da * kfill * aw_item
+        n_fb = 2 * Df * kfill * itemsize
+        Mb = -(-Sb // kfill)  # changed-mask rows (tracked mode)
+        Mv = -(-Cb // kfill)  # solve-moved mask rows
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+
+        def unpack(buf):
+            idx = jax.lax.bitcast_convert_type(
+                buf[:n_idx].reshape(-1, 4), jnp.int32
+            )
+            o = n_idx
+            a_w = jax.lax.bitcast_convert_type(
+                buf[o : o + n_aw].reshape(-1, aw_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            ).reshape(Da, kfill)
+            o += n_aw
+            f_block = jax.lax.bitcast_convert_type(
+                buf[o : o + n_fb].reshape(-1, itemsize), jdtype
+            ).reshape(2, Df, kfill)
+            o += n_fb
+            f_act = (buf[o : o + Df * kfill] != 0).reshape(Df, kfill)
+            return idx, a_w, f_block, f_act
+
+        def stage_and_solve(wants, has, sub, act, buf, scope_buf, cap,
+                            kind, learn, statc):
+            idx, a_w, f_block, f_act = unpack(buf)
+            a_idx = idx[:Da]
+            f_idx = idx[Da : Da + Df]
+            sel_idx = idx[Da + Df :]
+            wants = wants.at[a_idx, :kfill].set(a_w.astype(dtype))
+            has = has.at[f_idx, :kfill].set(f_block[0])
+            sub = sub.at[f_idx, :kfill].set(f_block[1])
+            act = act.at[f_idx, :kfill].set(f_act)
+            scope = scope_buf[:Cb]
+            fairpos = scope_buf[Cb:]
+            h_c = has[scope]
+            batch = DenseBatch(
+                wants=wants[scope], has=h_c, subclients=sub[scope],
+                active=act[scope], capacity=cap[scope],
+                algo_kind=kind[scope], learning=learn[scope],
+                static_capacity=statc[scope],
+            )
+            if use_pallas:
+                gets_c = solve_dense_pallas(batch)
+            else:
+                gets_c = solve_dense(
+                    batch, lanes=lanes,
+                    fair_rows=fairpos if want_fair else None,
+                )
+            # The fixpoint test, in the solve dtype: a scope row whose
+            # fresh solve equals its input has is back at rest.
+            moved = (gets_c != h_c).any(axis=1)
+            has = has.at[scope].set(gets_c)
+            out = has[sel_idx, :kfill].astype(out_dtype)
+            return wants, has, sub, act, out, sel_idx, moved
+
+        def moved_mask_rows(moved):
+            return jnp.pad(
+                moved.astype(out_dtype), (0, Mv * kfill - Cb)
+            ).reshape(Mv, kfill)
+
+        if track:
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(wants, has, sub, act, prev, buf, scope_buf, cap,
+                     kind, learn, statc):
+                wants, has, sub, act, out, sel_idx, moved = (
+                    stage_and_solve(
+                        wants, has, sub, act, buf, scope_buf, cap,
+                        kind, learn, statc,
+                    )
+                )
+                changed = (out != prev[sel_idx, :kfill]).any(axis=1)
+                prev = prev.at[sel_idx, :kfill].set(out)
+                mask = jnp.pad(
+                    changed.astype(out_dtype), (0, Mb * kfill - Sb)
+                ).reshape(Mb, kfill)
+                slab = jnp.concatenate(
+                    [out, mask, moved_mask_rows(moved)], axis=0
+                )
+                return wants, has, sub, act, prev, slab
+        else:
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(wants, has, sub, act, buf, scope_buf, cap, kind,
+                     learn, statc):
+                wants, has, sub, act, out, _, moved = stage_and_solve(
+                    wants, has, sub, act, buf, scope_buf, cap, kind,
+                    learn, statc,
+                )
+                slab = jnp.concatenate(
+                    [out, moved_mask_rows(moved)], axis=0
+                )
+                return wants, has, sub, act, slab
+
+        self._tick_fns[key] = tick
+        return tick
+
+    def _tick_fn_mesh_fused_scoped(self, Da: int, Df: int, Sb: int,
+                                   Cb: int, Fcb: int, lanes: frozenset,
+                                   use_bf16: bool):
+        """Mesh variant of the scoped fused tick: each shard gathers
+        its OWN scoped rows (the per-shard scoped extent: shard-local
+        indices in its slice of the cached scope buffer, padded with
+        the out-of-range index Rl so padded slots gather-clip and
+        scatter-drop), solves the compact per-shard block, and
+        scatters back into its resident slab — rows are independent,
+        so no collective is needed and per-row bits match the
+        single-device compact solve. The solve-moved mask lands as a
+        separate [n_dev, Cb] output (the mesh delivery already lands
+        grants and changed mask as separate per-shard streams)."""
+        track = self._track_deltas
+        key = (
+            "fused_mesh_scoped", Da, Df, Sb, Cb, Fcb, self._kfill,
+            lanes, track, use_bf16,
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.solver.batch import _committed_platform
+        from doorman_tpu.solver.dense import DenseBatch, solve_dense
+
+        use_pallas = (
+            _committed_platform(self._wants) == "tpu"
+            and self._dtype == np.float32
+        )
+        if use_pallas:
+            from doorman_tpu.solver.pallas_dense import solve_dense_pallas
+
+        kfill = self._kfill
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        axes = self._meshrows.axes
+        itemsize = int(np.dtype(dtype).itemsize)
+        aw_item = 2 if use_bf16 else itemsize
+        n_idx = (Da + Df + Sb) * 4
+        n_aw = Da * kfill * aw_item
+        n_fb = 2 * Df * kfill * itemsize
+        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+
+        def unpack(buf):
+            idx = jax.lax.bitcast_convert_type(
+                buf[:n_idx].reshape(-1, 4), jnp.int32
+            )
+            o = n_idx
+            a_w = jax.lax.bitcast_convert_type(
+                buf[o : o + n_aw].reshape(-1, aw_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            ).reshape(Da, kfill)
+            o += n_aw
+            f_block = jax.lax.bitcast_convert_type(
+                buf[o : o + n_fb].reshape(-1, itemsize), jdtype
+            ).reshape(2, Df, kfill)
+            o += n_fb
+            f_act = (buf[o : o + Df * kfill] != 0).reshape(Df, kfill)
+            return idx, a_w, f_block, f_act
+
+        def _core(wants, has, sub, act, buf, scope_buf, cap, kind,
+                  learn, statc):
+            idx, a_w, f_block, f_act = unpack(buf[0])
+            a_idx = idx[:Da]
+            f_idx = idx[Da : Da + Df]
+            sel_idx = idx[Da + Df :]
+            wants = wants.at[a_idx, :kfill].set(
+                a_w.astype(dtype), mode="drop"
+            )
+            has = has.at[f_idx, :kfill].set(f_block[0], mode="drop")
+            sub = sub.at[f_idx, :kfill].set(f_block[1], mode="drop")
+            act = act.at[f_idx, :kfill].set(f_act, mode="drop")
+            sb = scope_buf[0]
+            scope = sb[:Cb]
+            fairpos = sb[Cb:]
+
+            def take_rows(tbl):
+                return jnp.take(
+                    tbl, scope, axis=0, mode="clip",
+                    indices_are_sorted=True,
+                )
+
+            h_c = take_rows(has)
+            batch = DenseBatch(
+                wants=take_rows(wants), has=h_c,
+                subclients=take_rows(sub), active=take_rows(act),
+                capacity=jnp.take(cap, scope, mode="clip"),
+                algo_kind=jnp.take(kind, scope, mode="clip"),
+                learning=jnp.take(learn, scope, mode="clip"),
+                static_capacity=jnp.take(statc, scope, mode="clip"),
+            )
+            if use_pallas:
+                gets_c = solve_dense_pallas(batch)
+            else:
+                gets_c = solve_dense(
+                    batch, lanes=lanes,
+                    fair_rows=fairpos if want_fair else None,
+                )
+            moved = (gets_c != h_c).any(axis=1)
+            has = has.at[scope].set(gets_c, mode="drop")
+            out = jnp.take(
+                has, sel_idx, axis=0, mode="clip",
+                indices_are_sorted=True,
+            )[:, :kfill].astype(out_dtype)
+            return wants, has, sub, act, out, sel_idx, moved
+
+        rowk = P(axes, None)
+        row = P(axes)
+        dev2 = P(axes, None, None)
+        in_specs_tail = (
+            row,  # fused uint8 buffer [n_dev, B]
+            rowk,  # scope buffer [n_dev, Cb + Fcb] (shard-local)
+            row, row, row, row,  # per-row config
+        )
+
+        if track:
+            def body(wants, has, sub, act, prev, buf, scope_buf, cap,
+                     kind, learn, statc):
+                wants, has, sub, act, out, sel_idx, moved = _core(
+                    wants, has, sub, act, buf, scope_buf, cap, kind,
+                    learn, statc,
+                )
+                prev_sel = jnp.take(
+                    prev, sel_idx, axis=0, mode="clip",
+                    indices_are_sorted=True,
+                )[:, :kfill]
+                changed = (out != prev_sel).any(axis=1)
+                prev = prev.at[sel_idx, :kfill].set(out, mode="drop")
+                return (
+                    wants, has, sub, act, prev, out[None],
+                    changed[None], moved[None],
+                )
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(
+                    rowk, rowk, rowk, rowk, rowk, dev2,
+                    P(axes, None), P(axes, None),
+                ),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+            def tick(*args):
+                return mapped(*args)
+        else:
+            def body(wants, has, sub, act, buf, scope_buf, cap, kind,
+                     learn, statc):
+                wants, has, sub, act, out, _, moved = _core(
+                    wants, has, sub, act, buf, scope_buf, cap, kind,
+                    learn, statc,
+                )
+                return wants, has, sub, act, out[None], moved[None]
+
+            mapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(rowk, rowk, rowk, rowk) + in_specs_tail,
+                out_specs=(
+                    rowk, rowk, rowk, rowk, dev2, P(axes, None),
+                ),
+            )
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def tick(*args):
+                return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
+
     def _tick_fn_mesh_fused(self, Da: int, Df: int, Sb: int,
                             lanes: frozenset, use_bf16: bool):
         """Mesh variant of the fused upload: each shard's staged
@@ -928,6 +1264,7 @@ class ResidentDenseSolver(TickEngineBase):
 
     def _launch(self, res_list, drained, config_changed, now, ph):
         dirty_rows, dirty_full, fused, fwin, frows = drained
+        dirty_real = dirty_rows  # pre-sentinel: the frontier entries
         if len(dirty_rows) == 0:
             # No demand changes: scatter the reserved zero padding row.
             dirty_rows = np.asarray([self._R], np.int64)
@@ -965,6 +1302,20 @@ class ResidentDenseSolver(TickEngineBase):
         is_full[:n_full] = True
         is_full |= versions != self._uploaded_versions[order]
         self._uploaded_versions[order] = versions
+        # Solve-mode decision for this tick (after the pack loop, which
+        # may have rebuilt): the scoped path solves only the dirty rows
+        # plus the host frontier; any escalation reason forces the full
+        # executable. A mid-launch rebuild replaced dirty_real's row
+        # ids, but its seed_all covers every row anyway.
+        scope, _forced = self._scope_for_tick(
+            dirty_real, config_changed, self._R
+        )
+        if scope is not None:
+            self.last_scope = {
+                "rows": int(len(scope)), "resources": int(len(scope)),
+            }
+        else:
+            self.last_scope = {"rows": self._R, "resources": self._R}
         ph.lap("pack")
 
         # Delivery set: every dirty row + every config-changed row + the
@@ -993,7 +1344,8 @@ class ResidentDenseSolver(TickEngineBase):
 
         if self._meshrows is not None:
             return self._stage_mesh(
-                order, is_full, w, h, s, act, sel, now, ph, fwin, rows_hit
+                order, is_full, w, h, s, act, sel, now, ph, fwin,
+                rows_hit, scope,
             )
 
         kfill = self._kfill
@@ -1045,29 +1397,76 @@ class ResidentDenseSolver(TickEngineBase):
                 np.ascontiguousarray(f_block).view(np.uint8).ravel(),
                 f_act.view(np.uint8).ravel(),
             ])
+            if scope is not None:
+                # Scoped staging: the compact gather set (pow2 bucket,
+                # clamped at the padded table — a 100%-churn scope
+                # must never gather MORE than the full table) plus the
+                # FAIR_SHARE positions WITHIN the compact table, one
+                # cached int32 buffer. Padding slots repeat the
+                # reserved padding row.
+                Cb = min(pow2_bucket(len(scope), 8), self._Rp)
+                fairpos = np.nonzero(
+                    self._config.kind_h[scope]
+                    == int(AlgoKind.FAIR_SHARE)
+                )[0]
+                Fcb = pow2_bucket(max(len(fairpos), 1), 8)
+                scope_host = np.full(Cb + Fcb, 0, np.int32)
+                scope_host[:Cb] = self._R
+                scope_host[: len(scope)] = scope
+                if len(fairpos):
+                    scope_host[Cb:] = np.resize(fairpos, Fcb)
             ph.lap("staging")
-            tick = self._tick_fn_fused(Da, Df, Sb, lanes, use_bf16)
-            buf_d = self._put(buf)
             mask_rows = 0
+            moved_rows = 0
             changed_d = None
-            if self._track_deltas:
-                (
-                    self._wants, self._has, self._sub, self._act,
-                    self._prev, out
-                ) = tick(
-                    self._wants, self._has, self._sub, self._act,
-                    self._prev, buf_d, fair_d,
-                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+            if scope is not None:
+                tick = self._tick_fn_fused_scoped(
+                    Da, Df, Sb, Cb, Fcb, lanes, use_bf16
                 )
-                mask_rows = -(-Sb // kfill)
+                buf_d = self._put(buf)
+                scope_d = self._place_scope(scope_host, self._put)
+                moved_rows = -(-Cb // kfill)
+                if self._track_deltas:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, out
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, buf_d, scope_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
+                    mask_rows = -(-Sb // kfill)
+                else:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        out
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        buf_d, scope_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
             else:
-                (
-                    self._wants, self._has, self._sub, self._act, out
-                ) = tick(
-                    self._wants, self._has, self._sub, self._act,
-                    buf_d, fair_d,
-                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-                )
+                tick = self._tick_fn_fused(Da, Df, Sb, lanes, use_bf16)
+                buf_d = self._put(buf)
+                if self._track_deltas:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, out
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, buf_d, fair_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
+                    mask_rows = -(-Sb // kfill)
+                else:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        out
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        buf_d, fair_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
             count_launch()
             # One download stream: the fused slab already carries
             # grants + mask contiguously, and a single async copy is
@@ -1090,6 +1489,9 @@ class ResidentDenseSolver(TickEngineBase):
                 fused_rows=rows_hit,
                 changed=changed_d,
                 mask_rows=mask_rows,
+                scope_ids=scope,
+                moved_rows=moved_rows,
+                seq=self._seq,
             )
 
         ph.lap("staging")
@@ -1144,7 +1546,7 @@ class ResidentDenseSolver(TickEngineBase):
         )
 
     def _stage_mesh(self, order, is_full, w, h, s, act, sel, now, ph,
-                    fwin=0, rows_hit=0):
+                    fwin=0, rows_hit=0, scope=None):
         """Mesh tail of the launch: group this tick's row scatters and
         the delivery set by owning shard, stage per-shard blocks (the
         sharded device_put moves only each shard's slice onto its
@@ -1213,6 +1615,45 @@ class ResidentDenseSolver(TickEngineBase):
         lanes = self._config.lanes()
         fair_d = self._fair_rows()
         fused = self._fused
+        counts_c = None
+        if scope is not None:
+            # Per-shard scoped extents: the global (sorted) scope
+            # groups into contiguous shard-local blocks; pads carry the
+            # out-of-range index Rl (gather-clip / scatter-drop). The
+            # compact FAIR_SHARE positions are per shard too.
+            owner_c = scope // Rl
+            counts_c, (scope_loc,) = group_by_shard(
+                owner_c, n_dev, [scope - owner_c * Rl]
+            )
+            Cb = min(
+                pow2_bucket(
+                    int(counts_c.max()) if len(scope) else 1, 8
+                ),
+                Rl,
+            )
+            scope_blocks = np.full((n_dev, Cb), Rl, np.int32)
+            fair_counts = np.zeros(n_dev, np.int64)
+            fair_locs = []
+            pos = 0
+            kind_h = self._config.kind_h
+            for d in range(n_dev):
+                c = int(counts_c[d])
+                scope_blocks[d, :c] = scope_loc[pos : pos + c]
+                fp = np.nonzero(
+                    kind_h[scope[pos : pos + c]]
+                    == int(AlgoKind.FAIR_SHARE)
+                )[0]
+                fair_counts[d] = len(fp)
+                fair_locs.append(fp)
+                pos += c
+            Fcb = pow2_bucket(max(int(fair_counts.max()), 1), 8)
+            fair_blocks = np.zeros((n_dev, Fcb), np.int32)
+            for d, fp in enumerate(fair_locs):
+                if len(fp):
+                    fair_blocks[d] = np.resize(fp, Fcb)
+            scope_host = np.concatenate(
+                [scope_blocks, fair_blocks], axis=1
+            )
         if fused:
             # Fused upload: one [n_dev, B] uint8 buffer whose per-shard
             # slice carries that shard's staged blocks back to back
@@ -1250,11 +1691,37 @@ class ResidentDenseSolver(TickEngineBase):
         put = self._put_rows
         cfg = self._config
         changed_d = None
+        moved_d = None
         if fused:
             use_bf16 = a_w_b.dtype != dtype
-            tick = self._tick_fn_mesh_fused(Da, Df, Sb, lanes, use_bf16)
             buf_d = put(buf_host)
-            if self._track_deltas:
+            if scope is not None:
+                tick = self._tick_fn_mesh_fused_scoped(
+                    Da, Df, Sb, Cb, Fcb, lanes, use_bf16
+                )
+                scope_d = self._place_scope(scope_host, put)
+                if self._track_deltas:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, out, changed_d, moved_d
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        self._prev, buf_d, scope_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
+                else:
+                    (
+                        self._wants, self._has, self._sub, self._act,
+                        out, moved_d
+                    ) = tick(
+                        self._wants, self._has, self._sub, self._act,
+                        buf_d, scope_d,
+                        cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                    )
+            elif self._track_deltas:
+                tick = self._tick_fn_mesh_fused(
+                    Da, Df, Sb, lanes, use_bf16
+                )
                 (
                     self._wants, self._has, self._sub, self._act,
                     self._prev, out, changed_d
@@ -1264,6 +1731,9 @@ class ResidentDenseSolver(TickEngineBase):
                     cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                 )
             else:
+                tick = self._tick_fn_mesh_fused(
+                    Da, Df, Sb, lanes, use_bf16
+                )
                 (
                     self._wants, self._has, self._sub, self._act, out
                 ) = tick(
@@ -1313,6 +1783,10 @@ class ResidentDenseSolver(TickEngineBase):
             fused_windows=fwin,
             fused_rows=rows_hit,
             changed=changed_d,
+            scope_ids=scope,
+            moved=moved_d,
+            scope_counts=counts_c,
+            seq=self._seq,
         )
 
     def _apply_grants(self, handle: TickHandle, gets: np.ndarray) -> int:

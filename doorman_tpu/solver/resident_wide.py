@@ -23,11 +23,20 @@ bottleneck at 1M leases, and the whole point of this layout):
             int32 when the table fits (engine.compact_index_dtype —
             half the index bytes), and the wants-value block ships bf16
             when that round-trips exactly (engine.bf16_exact).
-  solve:    the full table every tick; `has` chains on device. Absent
-            algorithm lanes are skipped via the config mirror's static
-            lane mask (solver.lanes — byte-identical by construction;
-            the chunked layout keeps the full-table water-fill when a
-            FAIR_SHARE segment exists, since a segment spans rows).
+  solve:    scoped by default to the SEGMENT closure of the dirty
+            slots plus the not-yet-converged frontier — every
+            straddling chunk of every touched resource gathers into a
+            compact table (per-segment lanes couple all of a
+            resource's chunks, so the closure is the correctness
+            invariant), solves with the exact two-level reduction, and
+            scatters back into the resident slab; byte-identical to
+            the full solve, which any escalation still runs loudly
+            (engine.ScopeTracker). `has` chains on device either way.
+            Absent algorithm lanes are skipped via the config mirror's
+            static lane mask (solver.lanes — byte-identical by
+            construction; the chunked layout keeps the full-table
+            water-fill when a FAIR_SHARE segment exists, since a
+            segment spans rows).
   delivery: chunk rows being DELIVERED this tick: rows containing
             full-dirty slots (membership / client-reported has — these
             must land in the store promptly), every row of a resource
@@ -77,6 +86,7 @@ from doorman_tpu.solver.engine import (
     ceil_to,
     compact_index_dtype,
     count_launch,
+    pow2_bucket,
 )
 from doorman_tpu.solver.engine import _BF16
 from doorman_tpu.solver.resident import _ceil_to  # noqa: F401 (compat)
@@ -106,6 +116,7 @@ class WideResidentSolver(TickEngineBase):
         download_dtype=None,
         chunk_width: "int | None" = None,
         fused: bool = True,
+        scoped: bool = True,
     ):
         super().__init__(
             engine,
@@ -118,6 +129,7 @@ class WideResidentSolver(TickEngineBase):
             download_dtype=download_dtype,
             config_put=self._put_rep,
             fused=fused,
+            scoped=scoped,
         )
         self._W = int(chunk_width or DENSE_MAX_K)
         self._res: List[Resource] = []
@@ -202,6 +214,7 @@ class WideResidentSolver(TickEngineBase):
         self._refresh_config(res, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
+        self._drop_scope_cache()
 
     def _needs_rebuild(self, resources: List[Resource]) -> bool:
         if self._wants is None or len(resources) != self._S or any(
@@ -470,6 +483,289 @@ class WideResidentSolver(TickEngineBase):
         self._tick_fns[key] = tick
         return tick
 
+    def _tick_fn_fused_scoped(self, Dw: int, Df: int, Sb: int, Cb: int,
+                              Scb: int, lanes: frozenset,
+                              use_bf16: bool):
+        """Scoped fused wide tick: the group closure in action. The
+        scope buffer (cached int32) carries the scoped segments'
+        ENTIRE chunk-row span — every straddling chunk of every
+        touched segment, the correctness invariant for per-segment
+        lanes — as [Cb] row indices, their compact segment map [Cb],
+        and the scoped segment ids [Scb] (config gather). The compact
+        solve runs solve_chunked's exact two-level reduction over the
+        compact rows (same per-row values, same addition order —
+        bit-identical totals per scoped segment), fresh grants scatter
+        back into the donated resident slab, delivery gathers from the
+        slab, and the per-SEGMENT solve-moved mask (segment-any of the
+        per-row fixpoint test) packs into the slab tail for the host
+        frontier. Padding rows point at the reserved padding row and
+        the reserved compact padding segment Scb-1 (seg id Sp-1:
+        capacity 0, inactive)."""
+        key = (
+            "fused_scoped", Dw, Df, Sb, Cb, Scb, lanes, use_bf16,
+            self._idx_dtype,
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from doorman_tpu.solver.dense import (
+            ChunkedDenseBatch,
+            chunked_reduces,
+            solve_chunked,
+        )
+
+        Rp, W = self._Rp, self._W
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        sizes, idt_size, wval_item, itemsize = self._fused_layout(
+            Dw, Df, Sb, use_bf16
+        )
+        idt_j = jnp.dtype(self._idx_dtype)
+        Mv = -(-Scb // W)  # moved-mask rows appended to the slab
+
+        def unpack(buf):
+            o = 0
+            parts = []
+            for n in sizes:
+                parts.append(buf[o : o + n])
+                o += n
+            w_idx = jax.lax.bitcast_convert_type(
+                parts[0].reshape(-1, idt_size), idt_j
+            )
+            w_val = jax.lax.bitcast_convert_type(
+                parts[1].reshape(-1, wval_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            )
+            f_idx = jax.lax.bitcast_convert_type(
+                parts[2].reshape(-1, idt_size), idt_j
+            )
+            f_w, f_h, f_s = (
+                jax.lax.bitcast_convert_type(
+                    p.reshape(-1, itemsize), jdtype
+                )
+                for p in parts[3:6]
+            )
+            sel_idx = jax.lax.bitcast_convert_type(
+                parts[6].reshape(-1, 4), jnp.int32
+            )
+            f_a = parts[7] != 0
+            return w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(wants, has, sub, act, buf, scope_buf, cap, kind,
+                 learn, statc):
+            (
+                w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+            ) = unpack(buf)
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val.astype(dtype))
+                .at[f_idx].set(f_w)
+                .reshape(Rp, W)
+            )
+            has = has.reshape(-1).at[f_idx].set(f_h).reshape(Rp, W)
+            sub = sub.reshape(-1).at[f_idx].set(f_s).reshape(Rp, W)
+            act = act.reshape(-1).at[f_idx].set(f_a).reshape(Rp, W)
+            rows = scope_buf[:Cb]
+            row_seg_c = scope_buf[Cb : 2 * Cb]
+            seg_ids = scope_buf[2 * Cb :]
+            h_c = has[rows]
+            gets_c = solve_chunked(
+                ChunkedDenseBatch(
+                    wants=wants[rows], has=h_c, subclients=sub[rows],
+                    active=act[rows], row_seg=row_seg_c,
+                    capacity=cap[seg_ids], algo_kind=kind[seg_ids],
+                    learning=learn[seg_ids],
+                    static_capacity=statc[seg_ids],
+                ),
+                lanes=lanes,
+            )
+            # Per-segment fixpoint test: any chunk row of the segment
+            # whose fresh solve differs from its input has.
+            segsum, _ = chunked_reduces(row_seg_c, Scb)
+            moved_seg = (
+                segsum(
+                    (gets_c != h_c).any(axis=1).astype(dtype)[:, None]
+                )
+                > 0
+            )
+            has = has.at[rows].set(gets_c)
+            out = has[sel_idx, :].astype(out_dtype)
+            mvd = jnp.pad(
+                moved_seg.astype(out_dtype), (0, Mv * W - Scb)
+            ).reshape(Mv, W)
+            slab = jnp.concatenate([out, mvd], axis=0)
+            return wants, has, sub, act, slab
+
+        self._tick_fns[key] = tick
+        return tick
+
+    def _tick_fn_mesh_fused_scoped(self, Dw: int, Df: int, Sb: int,
+                                   Cbl: int, Cbg: int, Scb: int,
+                                   lanes: frozenset, use_bf16: bool):
+        """Mesh variant of the scoped wide tick: per-shard scoped
+        extents with the straddling-chunk psum RESTRICTED to scoped
+        chunks. Each shard's slice of the scope buffer carries its
+        local scoped rows ([Cbl], pad Rl: gather-clip / scatter-drop),
+        their global compact positions ([Cbl], pad Cbg: dropped from
+        the assemble), and the replicated global compact segment map
+        [Cbg] + scoped segment ids [Scb]. The two-level reduction runs
+        through parallel.sharded.scoped_chunk_reduces — a [Cbg]-sized
+        psum/pmax over disjoint supports instead of the full [Rp]
+        collective, bit-identical per scoped segment (same rows, same
+        order, identity elsewhere)."""
+        key = (
+            "fused_mesh_scoped", Dw, Df, Sb, Cbl, Cbg, Scb, lanes,
+            use_bf16, self._idx_dtype,
+        )
+        fn = self._tick_fns.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from doorman_tpu.parallel.compat import shard_map
+        from doorman_tpu.parallel.sharded import scoped_chunk_reduces
+        from doorman_tpu.solver.lanes import solve_lanes
+
+        mr = self._meshrows
+        axes = mr.axes
+        Rp, W = self._Rp, self._W
+        Rl = Rp // mr.n_dev
+        dtype = self._dtype
+        jdtype = jnp.dtype(dtype)
+        out_dtype = self._out_dtype
+        sizes, idt_size, wval_item, itemsize = self._fused_layout(
+            Dw, Df, Sb, use_bf16
+        )
+        idt_j = jnp.dtype(self._idx_dtype)
+
+        def unpack(buf):
+            o = 0
+            parts = []
+            for n in sizes:
+                parts.append(buf[o : o + n])
+                o += n
+            w_idx = jax.lax.bitcast_convert_type(
+                parts[0].reshape(-1, idt_size), idt_j
+            )
+            w_val = jax.lax.bitcast_convert_type(
+                parts[1].reshape(-1, wval_item),
+                jnp.bfloat16 if use_bf16 else jdtype,
+            )
+            f_idx = jax.lax.bitcast_convert_type(
+                parts[2].reshape(-1, idt_size), idt_j
+            )
+            f_w, f_h, f_s = (
+                jax.lax.bitcast_convert_type(
+                    p.reshape(-1, itemsize), jdtype
+                )
+                for p in parts[3:6]
+            )
+            sel_idx = jax.lax.bitcast_convert_type(
+                parts[6].reshape(-1, 4), jnp.int32
+            )
+            f_a = parts[7] != 0
+            return w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+
+        def body(wants, has, sub, act, buf, scope_buf, cap, kind,
+                 learn, statc):
+            (
+                w_idx, w_val, f_idx, f_w, f_h, f_s, f_a, sel_idx
+            ) = unpack(buf[0])
+            wants = (
+                wants.reshape(-1)
+                .at[w_idx].set(w_val.astype(dtype), mode="drop")
+                .at[f_idx].set(f_w, mode="drop")
+                .reshape(Rl, W)
+            )
+            has = (
+                has.reshape(-1).at[f_idx].set(f_h, mode="drop")
+                .reshape(Rl, W)
+            )
+            sub = (
+                sub.reshape(-1).at[f_idx].set(f_s, mode="drop")
+                .reshape(Rl, W)
+            )
+            act = (
+                act.reshape(-1).at[f_idx].set(f_a, mode="drop")
+                .reshape(Rl, W)
+            )
+            sb = scope_buf[0]
+            rows_l = sb[:Cbl]
+            gpos = sb[Cbl : 2 * Cbl]
+            row_seg_cg = sb[2 * Cbl : 2 * Cbl + Cbg]
+            seg_ids = sb[2 * Cbl + Cbg :]
+
+            def take_rows(tbl):
+                return jnp.take(
+                    tbl, rows_l, axis=0, mode="clip",
+                    indices_are_sorted=True,
+                )
+
+            segsum, segmax = scoped_chunk_reduces(
+                self._mesh, gpos, row_seg_cg, Cbg, Scb
+            )
+            # Local compact row -> compact segment (pad slots clip to
+            # the last global position, whose segment is the compact
+            # padding segment Scb-1).
+            seg_l = jnp.take(row_seg_cg, gpos, mode="clip")
+            h_c = take_rows(has)
+            gets_c = solve_lanes(
+                take_rows(wants), h_c, take_rows(sub), take_rows(act),
+                cap[seg_ids], kind[seg_ids], learn[seg_ids],
+                statc[seg_ids],
+                segsum=segsum, segmax=segmax,
+                expand=lambda totals: totals[seg_l][:, None],
+                lanes=lanes,
+            )
+            moved_seg = (
+                segsum(
+                    (gets_c != h_c).any(axis=1).astype(dtype)[:, None]
+                )
+                > 0
+            )
+            has = has.at[rows_l].set(gets_c, mode="drop")
+            out = jnp.take(
+                has, sel_idx, axis=0, mode="clip",
+                indices_are_sorted=True,
+            ).astype(out_dtype)
+            return wants, has, sub, act, out[None], moved_seg
+
+        rowk = P(axes, None)
+        row = P(axes)
+        rep = P()
+        mapped = shard_map(
+            body,
+            mesh=self._mesh,
+            in_specs=(
+                rowk, rowk, rowk, rowk,  # tables
+                row,  # fused uint8 buffer [n_dev, B]
+                row,  # scope buffer [n_dev, 2*Cbl + Cbg + Scb]
+                rep, rep, rep, rep,  # per-segment config
+            ),
+            out_specs=(
+                rowk, rowk, rowk, rowk, P(axes, None, None), rep,
+            ),
+        )
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def tick(*args):
+            return mapped(*args)
+
+        self._tick_fns[key] = tick
+        return tick
+
     def _tick_fn_mesh_fused(self, Dw: int, Df: int, Sb: int,
                             lanes: frozenset, use_bf16: bool):
         """Mesh variant of the wide fused upload: each shard's staged
@@ -649,6 +945,27 @@ class WideResidentSolver(TickEngineBase):
         W = self._W
         full_mask = levels >= 2
         dirty_rows = flat_idx // W
+        # Solve-mode decision: the scope unit is the SEGMENT (the
+        # group closure — per-segment lanes couple every chunk of a
+        # resource, so one dirty slot scopes the segment's whole
+        # straddling-chunk span).
+        if len(dirty_rows):
+            dirty_segs = self._row_seg_h[np.unique(dirty_rows)]
+            dirty_segs = np.unique(dirty_segs[dirty_segs < self._S])
+        else:
+            dirty_segs = np.zeros(0, np.int64)
+        scope, _forced = self._scope_for_tick(
+            dirty_segs, config_changed, self._S
+        )
+        if scope is not None:
+            self.last_scope = {
+                "rows": int(self._n_chunks[scope].sum())
+                if len(scope)
+                else 0,
+                "resources": int(len(scope)),
+            }
+        else:
+            self.last_scope = {"rows": self._R, "resources": self._S}
         rot = self._rotation_rows(
             self._R,
             self._Rp // self._meshrows.n_dev
@@ -733,6 +1050,7 @@ class WideResidentSolver(TickEngineBase):
             return self._stage_mesh(
                 w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
                 sel, sel_rids, sel_chunks, versions, keep, now, ph,
+                scope,
             )
 
         Dw = ceil_to(n_w, 1024)
@@ -768,6 +1086,7 @@ class WideResidentSolver(TickEngineBase):
         cfg = self._config
         from doorman_tpu.utils.transfer import start_download
 
+        moved_rows = 0
         if self._fused:
             # One-launch fused wide tick: all eight staged blocks in
             # one uint8 buffer, one placement, one launch, one download
@@ -777,15 +1096,63 @@ class WideResidentSolver(TickEngineBase):
                 [np.ascontiguousarray(b).view(np.uint8).ravel()
                  for b in host_blocks]
             )
+            if scope is not None:
+                # Scoped staging: the group closure's whole chunk-row
+                # span, its compact segment map, and the scoped
+                # segment ids — one cached int32 buffer. Scb reserves
+                # a compact padding segment above every real one.
+                scope_rows = (
+                    np.concatenate([
+                        np.arange(
+                            self._base_row[s],
+                            self._base_row[s] + self._n_chunks[s],
+                            dtype=np.int64,
+                        )
+                        for s in scope
+                    ])
+                    if len(scope)
+                    else np.zeros(0, np.int64)
+                )
+                Cb = min(
+                    pow2_bucket(max(len(scope_rows), 1), 8), self._Rp
+                )
+                Scb = pow2_bucket(len(scope) + 1, 8)
+                scope_host = np.full(2 * Cb + Scb, 0, np.int32)
+                scope_host[:Cb] = self._R
+                scope_host[: len(scope_rows)] = scope_rows
+                row_seg_c = np.full(Cb, Scb - 1, np.int32)
+                row_seg_c[: len(scope_rows)] = np.repeat(
+                    np.arange(len(scope), dtype=np.int32),
+                    self._n_chunks[scope],
+                )
+                scope_host[Cb : 2 * Cb] = row_seg_c
+                seg_ids = np.full(Scb, self._Sp - 1, np.int32)
+                seg_ids[: len(scope)] = scope
+                scope_host[2 * Cb :] = seg_ids
             ph.lap("staging")
-            tick = self._tick_fn_fused(Dw, Df, Sb, lanes, use_bf16)
             buf_d = self._put(buf)
-            (
-                self._wants, self._has, self._sub, self._act, out
-            ) = tick(
-                self._wants, self._has, self._sub, self._act, buf_d,
-                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-            )
+            if scope is not None:
+                tick = self._tick_fn_fused_scoped(
+                    Dw, Df, Sb, Cb, Scb, lanes, use_bf16
+                )
+                scope_d = self._place_scope(scope_host, self._put)
+                moved_rows = -(-Scb // W)
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    buf_d, scope_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            else:
+                tick = self._tick_fn_fused(Dw, Df, Sb, lanes, use_bf16)
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    buf_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
             count_launch()
             out = start_download(out, chunks=1)
             ph.lap("fused")
@@ -816,10 +1183,14 @@ class WideResidentSolver(TickEngineBase):
             n_sel=n_sel,
             dispatched_at=now,
             chunks=sel_chunks,
+            scope_ids=scope,
+            moved_rows=moved_rows,
+            seq=self._seq,
         )
 
     def _stage_mesh(self, w_idx, w_val, f_idx, f_w, f_h, f_s, f_a,
-                    sel, sel_rids, sel_chunks, versions, keep, now, ph):
+                    sel, sel_rids, sel_chunks, versions, keep, now, ph,
+                    scope=None):
         """Mesh tail of the launch: slot scatters and the delivery set
         grouped by owning shard; per-shard blocks land only on their
         own device, the shard_mapped tick solves with the bit-stable
@@ -876,6 +1247,63 @@ class WideResidentSolver(TickEngineBase):
         sel_b = pad_shard_indices(counts_sel, Sb, sel_l).astype(np.int32)
         lanes = self._config.lanes()
         fused = self._fused
+        if fused and scope is not None:
+            # Per-shard scoped extents: each shard's slice of the
+            # scope buffer carries its local scoped rows + their
+            # global compact positions; the replicated compact segment
+            # map and scoped segment ids ride in every slice (one
+            # placement, no second replicated upload).
+            scope_rows = (
+                np.concatenate([
+                    np.arange(
+                        self._base_row[s],
+                        self._base_row[s] + self._n_chunks[s],
+                        dtype=np.int64,
+                    )
+                    for s in scope
+                ])
+                if len(scope)
+                else np.zeros(0, np.int64)
+            )
+            Cbg = min(pow2_bucket(max(len(scope_rows), 1), 8), self._Rp)
+            Scb = pow2_bucket(len(scope) + 1, 8)
+            row_seg_cg = np.full(Cbg, Scb - 1, np.int32)
+            row_seg_cg[: len(scope_rows)] = np.repeat(
+                np.arange(len(scope), dtype=np.int32),
+                self._n_chunks[scope],
+            )
+            seg_ids = np.full(Scb, self._Sp - 1, np.int32)
+            seg_ids[: len(scope)] = scope
+            owner_c = scope_rows // Rl
+            counts_c, (rows_loc, gpos_loc) = group_by_shard(
+                owner_c, n_dev,
+                [
+                    scope_rows - owner_c * Rl,
+                    np.arange(len(scope_rows), dtype=np.int64),
+                ],
+            )
+            Cbl = min(
+                pow2_bucket(
+                    max(
+                        int(counts_c.max()) if len(scope_rows) else 0,
+                        1,
+                    ),
+                    8,
+                ),
+                Rl,
+            )
+            rows_l_b, gpos_b = pad_shard_blocks(
+                counts_c, Cbl, [(rows_loc, Rl), (gpos_loc, Cbg)]
+            )
+            scope_host = np.concatenate(
+                [
+                    rows_l_b.astype(np.int32),
+                    gpos_b.astype(np.int32),
+                    np.tile(row_seg_cg, (n_dev, 1)),
+                    np.tile(seg_ids, (n_dev, 1)),
+                ],
+                axis=1,
+            )
         if fused:
             # Fused upload (see ResidentDenseSolver._stage_mesh): one
             # [n_dev, B] uint8 buffer, each shard's slice carrying its
@@ -908,17 +1336,34 @@ class WideResidentSolver(TickEngineBase):
         )
         put = self._put_rows
         cfg = self._config
+        moved_d = None
         if fused:
             use_bf16 = w_val_b.dtype != self._dtype
-            tick = self._tick_fn_mesh_fused(Dw, Df, Sb, lanes, use_bf16)
             buf_d = put(buf_host)
-            (
-                self._wants, self._has, self._sub, self._act, out
-            ) = tick(
-                self._wants, self._has, self._sub, self._act,
-                self._row_seg_d, buf_d,
-                cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
-            )
+            if scope is not None:
+                tick = self._tick_fn_mesh_fused_scoped(
+                    Dw, Df, Sb, Cbl, Cbg, Scb, lanes, use_bf16
+                )
+                scope_d = self._place_scope(scope_host, put)
+                (
+                    self._wants, self._has, self._sub, self._act,
+                    out, moved_d
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    buf_d, scope_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
+            else:
+                tick = self._tick_fn_mesh_fused(
+                    Dw, Df, Sb, lanes, use_bf16
+                )
+                (
+                    self._wants, self._has, self._sub, self._act, out
+                ) = tick(
+                    self._wants, self._has, self._sub, self._act,
+                    self._row_seg_d, buf_d,
+                    cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
+                )
             count_launch()
             out = start_sharded_download(out)
             ph.lap("fused")
@@ -949,6 +1394,9 @@ class WideResidentSolver(TickEngineBase):
             dispatched_at=now,
             chunks=sel_chunks,
             shard_counts=counts_sel,
+            scope_ids=scope,
+            moved=moved_d,
+            seq=self._seq,
         )
 
     def _apply_grants(self, handle: TickHandle, gets: np.ndarray) -> int:

@@ -18,11 +18,18 @@ What counts as what:
                 launch (`count_launch`), and one per extra slice op a
                 split download creates (`utils.transfer
                 .split_for_download` documents that each part beyond a
-                single-part download is its own device op).
+                single-part download is its own device op). The scoped
+                tick's scope-index buffer counts only when it is
+                actually re-placed: an unchanged scope reuses the
+                cached device copy (TickEngineBase._place_scope), so a
+                steady scoped tick reads 3 dispatches while churn
+                moves the scope and 2 at the quiet-tick fixpoint —
+                tests/test_scoped_solve.py pins both.
   host_syncs  — device->host landings the host WAITS on: one per part
                 `land_parts` consumes, one per direct device->host
                 `np.asarray`/`device_get` landing on the tick path
-                (the delta mask, the stream matcher's pairs).
+                (the delta mask, the mesh ticks' solve-moved mask,
+                the stream matcher's pairs).
 
 Increments are a few per tick, so one lock covers both counters.
 """
