@@ -445,7 +445,8 @@ def bench_server_tick() -> None:
     R, C = NUM_RESOURCES, CLIENTS_PER_RESOURCE
 
     def run(fused: bool, scoped: bool = False,
-            churn_res: int = CHURN_RESOURCES) -> dict:
+            churn_res: int = CHURN_RESOURCES,
+            lane: "tuple | None" = None) -> dict:
         """One full build + warmup + measured window; a fresh engine
         and rng per variant, so every path starts from byte-identical
         stores and replays the same-seeded churn stream. `fused` turns
@@ -453,7 +454,11 @@ def bench_server_tick() -> None:
         tick) plus admission-fused staging. `scoped` additionally
         scopes each tick's solve to the dirty rows + convergence
         frontier (the churn-proportional tick); `churn_res` is the
-        resources whose demand changes per tick (the churn tiers)."""
+        resources whose demand changes per tick (the churn tiers).
+        `lane` = (wire kind, variant|None) pins EVERY resource to one
+        algorithm lane (the fairness-portfolio rows; the rng still
+        draws the kind vector so the demand stream stays identical to
+        the mixed runs)."""
         rng = np.random.default_rng(11)
         engine = native.StoreEngine()
         kind_choices = np.array(
@@ -470,16 +475,27 @@ def bench_server_tick() -> None:
         )
         capacity = rng.integers(100, 100_000, R).astype(np.float64)
 
+        def algorithm(r: int) -> pb.Algorithm:
+            if lane is None:
+                return pb.Algorithm(
+                    kind=int(kinds[r]), lease_length=600,
+                    refresh_interval=16,
+                )
+            wkind, variant = lane
+            algo = pb.Algorithm(
+                kind=int(wkind), lease_length=600, refresh_interval=16
+            )
+            if variant is not None:
+                algo.parameters.add(name="variant", value=variant)
+            return algo
+
         resources = []
         rids = np.empty(R * C, np.int32)
         for r in range(R):
             tpl = pb.ResourceTemplate(
                 identifier_glob=f"res{r}",
                 capacity=float(capacity[r]),
-                algorithm=pb.Algorithm(
-                    kind=int(kinds[r]), lease_length=600,
-                    refresh_interval=16,
-                ),
+                algorithm=algorithm(r),
             )
             res = Resource(f"res{r}", tpl, store_factory=engine.store)
             resources.append(res)
@@ -516,7 +532,7 @@ def bench_server_tick() -> None:
         # Spot-check the first tick against the numpy oracles: after
         # it, has == grants computed from (capacity, wants, has=0).
         from doorman_tpu.algorithms.tick import oracle_row
-        from doorman_tpu.core.resource import static_param
+        from doorman_tpu.core.resource import algo_kind_for, static_param
 
         for r in rng.integers(0, R, 10):
             res = resources[r]
@@ -526,7 +542,9 @@ def bench_server_tick() -> None:
             ]
             w = np.array([lease.wants for lease in st])
             g = np.array([lease.has for lease in st])
-            k = int(kinds[r])
+            # The internal lane (variant-aware), not the wire kind:
+            # the portfolio rows pin their lane's own oracle.
+            k = algo_kind_for(res.template)
             expected = oracle_row(
                 k, float(capacity[r]), static_param(res.template),
                 w, np.zeros_like(w), np.ones_like(w),
@@ -894,6 +912,121 @@ def bench_server_tick() -> None:
         "worst_ratio_vs_full": worst_ratio,
         "full_solve_at_worst_tier_ms": round(full100_med, 3),
         "slo": prop_verdicts,
+    })
+
+    # ---- fairness portfolio: per-lane rows at the same 1M-lease
+    # shape, each through the FULL fused + scoped pipeline at the
+    # headline churn (every resource pinned to one lane via the
+    # config's `variant` parameter, the same demand stream as the
+    # mixed runs). Each row carries the tick-budget SLO — the "any
+    # algorithm, same sub-100 ms tick" claim as numbers — plus the
+    # standing <10 ms TPU verdict on tick-only p50.
+    headline_churn_res = max(
+        1, min(R, int(round(R * headline_frac)))
+    )
+    PORTFOLIO_LANES = [
+        ("fair_share", int(pb.Algorithm.FAIR_SHARE), None),
+        ("maxmin", int(pb.Algorithm.FAIR_SHARE), "maxmin"),
+        ("balanced", int(pb.Algorithm.FAIR_SHARE), "balanced"),
+        ("logutil", int(pb.Algorithm.PROPORTIONAL_SHARE), "logutil"),
+    ]
+    lane_dispatches = {}
+    for lname, wkind, variant in PORTFOLIO_LANES:
+        lrun = run(
+            fused=True, scoped=True, churn_res=headline_churn_res,
+            lane=(wkind, variant),
+        )
+        lrun["churn_res"] = headline_churn_res
+        lrow = tier_row(
+            headline_frac, lrun,
+            f"server_tick_1m_leases_native_store_{lname}_scoped_wall_ms",
+        )
+        lane_dispatches[lname] = lrun["dispatches_per_tick"]
+        lverdicts = []
+        budget = slo_mod.bench_verdict(lrow)
+        if budget is not None:
+            lverdicts.append(budget)
+        lverdicts.append(
+            slo_mod.tpu_tick_verdict(
+                float(np.percentile(lrun["tick_only"], 50)),
+                cpu_fallback=bool(
+                    _CPU_FALLBACK or device.platform == "cpu"
+                ),
+            )
+        )
+        lrow["slo"] = lverdicts
+        emit(lrow)
+
+    # Compile-away pin: a proportional-ONLY config (no iterative lane
+    # in the static kind set) must tick with the same per-tick
+    # dispatch/launch count as every portfolio run — absent lanes are
+    # compiled away, never launched around (the jaxpr-level pin lives
+    # in tests/test_fairness_lanes.py). Its scoped wall time is also
+    # the headline's "unchanged-within-noise" guard: the portfolio
+    # landing must not tax a deployment that never configures it.
+    prop_run = run(
+        fused=True, scoped=True, churn_res=headline_churn_res,
+        lane=(int(pb.Algorithm.PROPORTIONAL_SHARE), None),
+    )
+    prop_run["churn_res"] = headline_churn_res
+    prop_med_only = float(np.median(prop_run["tick_only"]))
+    mixed_med_only = float(
+        np.median(tiers[headline_frac]["tick_only"])
+    )
+    compile_away = all(
+        d == prop_run["dispatches_per_tick"]
+        for d in lane_dispatches.values()
+    )
+    ca_specs = [
+        slo_mod.SloSpec(
+            name="server_tick_portfolio:compile_away_dispatches",
+            kind="max", target=0.0, unit="count",
+            source={"type": "scalar", "key": "dispatch_spread"},
+            description=(
+                "max |dispatches_per_tick difference| between the "
+                "proportional-only config and every portfolio lane "
+                "run — absent lanes change executable content, never "
+                "launch structure"
+            ),
+        ),
+        slo_mod.SloSpec(
+            name="server_tick_portfolio:proportional_only_unchanged",
+            kind="max", target=1.15, unit="ratio",
+            source={"type": "scalar", "key": "prop_ratio"},
+            description=(
+                "proportional-only scoped tick-only median vs the "
+                "mixed headline tier — the portfolio must cost a "
+                "lane-free config nothing beyond noise"
+            ),
+        ),
+    ]
+    dispatch_spread = max(
+        abs(d - prop_run["dispatches_per_tick"])
+        for d in lane_dispatches.values()
+    )
+    prop_ratio = round(
+        prop_med_only / max(mixed_med_only, 1e-9), 3
+    )
+    ca_verdicts = slo_mod.SloEngine(ca_specs).evaluate(
+        slo_mod.SloInputs(
+            scalars={
+                "dispatch_spread": dispatch_spread,
+                "prop_ratio": prop_ratio,
+            }
+        )
+    )
+    emit({
+        "metric": "server_tick_fairness_portfolio_compile_away",
+        "value": prop_run["dispatches_per_tick"],
+        "unit": "dispatches_per_tick",
+        "dispatches_per_tick_by_lane": lane_dispatches,
+        "identical_launch_count": compile_away,
+        "proportional_only_tick_only_ms": round(prop_med_only, 3),
+        "proportional_only_wall_ms": round(
+            float(np.median(prop_run["timed"])), 3
+        ),
+        "ratio_vs_mixed_headline": prop_ratio,
+        "slo": ca_verdicts,
     })
 
     # The scoped steady-state tick is the round's HEADLINE (the LAST

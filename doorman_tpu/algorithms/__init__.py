@@ -8,10 +8,13 @@ reference for the batched TPU kernels in `doorman_tpu.solver`.
 from doorman_tpu.algorithms.kinds import AlgoKind  # noqa: F401
 from doorman_tpu.algorithms.scalar import (  # noqa: F401
     Request,
+    balanced_fairness,
     get_algorithm,
     get_parameter,
     learn,
+    max_min_fair,
     no_algorithm,
+    proportional_fairness,
     proportional_share,
     proportional_topup,
     static,

@@ -295,6 +295,72 @@ def priority_bands(config: pb.Algorithm) -> Algorithm:
     return algo
 
 
+def _portfolio_algorithm(config: pb.Algorithm, solve) -> Algorithm:
+    """Per-request form shared by the fairness-portfolio lanes
+    (MAX_MIN_FAIR / BALANCED_FAIRNESS / PROPORTIONAL_FAIRNESS): like
+    priority_bands, recompute the whole resource's allocation — every
+    stored lease plus this request — with the lane's numpy tick oracle,
+    and grant the requester its share clamped to the capacity not
+    promised to others (the incremental convergence discipline of the
+    other scalar forms; the batched tick reassigns everyone at once and
+    needs no clamp). `solve` is fn(capacity, wants[], subclients[]) ->
+    gets[]."""
+    import numpy as np
+
+    length, interval = _params(config)
+
+    def algo(store: LeaseStore, capacity: float, r: Request) -> Lease:
+        entries = {
+            c: (l.wants, float(l.subclients)) for c, l in store.items()
+        }
+        entries[r.client] = (r.wants, float(r.subclients))
+        clients = list(entries)
+        wants = np.array([entries[c][0] for c in clients], np.float64)
+        sub = np.array([entries[c][1] for c in clients], np.float64)
+        gets = solve(capacity, wants, sub)
+        available = max(
+            capacity - store.sum_has + store.get(r.client).has, 0.0
+        )
+        grant = min(float(gets[clients.index(r.client)]), available)
+        return store.assign(
+            r.client, length, interval, grant, r.wants, r.subclients,
+            priority=r.priority,
+        )
+
+    return algo
+
+
+def max_min_fair(config: pb.Algorithm) -> Algorithm:
+    """Client-granular (unweighted) max-min water-filling by the
+    fast-converging fill iteration (arxiv 2310.09699); wire form
+    FAIR_SHARE + parameter variant=maxmin. Oracle:
+    algorithms.tick.max_min_fair_tick."""
+    from doorman_tpu.algorithms import tick
+
+    return _portfolio_algorithm(
+        config, lambda cap, wants, sub: tick.max_min_fair_tick(cap, wants)
+    )
+
+
+def balanced_fairness(config: pb.Algorithm) -> Algorithm:
+    """Balanced fairness by the bounded recursive cap-peeling formula
+    (arxiv 1711.02880); wire form FAIR_SHARE + parameter
+    variant=balanced. Oracle: algorithms.tick.balanced_fairness_tick."""
+    from doorman_tpu.algorithms import tick
+
+    return _portfolio_algorithm(config, tick.balanced_fairness_tick)
+
+
+def proportional_fairness(config: pb.Algorithm) -> Algorithm:
+    """Weighted proportional fairness (Kelly log-utility dual fixpoint,
+    arxiv 1404.2266); wire form PROPORTIONAL_SHARE + parameter
+    variant=logutil. Oracle:
+    algorithms.tick.proportional_fairness_tick."""
+    from doorman_tpu.algorithms import tick
+
+    return _portfolio_algorithm(config, tick.proportional_fairness_tick)
+
+
 def get_parameter(config: pb.Algorithm, name: str, default: str | None = None):
     """Fetch a named algorithm parameter (analog of the simulation's
     get_named_parameter, algorithm.py:66-71)."""
@@ -313,13 +379,28 @@ _FACTORIES = {
 }
 
 
+# The `variant` parameter refines a wire kind into a portfolio lane;
+# server.config validates against this table so a typo'd variant fails
+# the config load instead of silently selecting the base lane.
+VARIANT_FACTORIES = {
+    (pb.Algorithm.PROPORTIONAL_SHARE, "topup"): proportional_topup,
+    (pb.Algorithm.PROPORTIONAL_SHARE, "logutil"): proportional_fairness,
+    (pb.Algorithm.FAIR_SHARE, "maxmin"): max_min_fair,
+    (pb.Algorithm.FAIR_SHARE, "balanced"): balanced_fairness,
+}
+
+
 def get_algorithm(config: pb.Algorithm) -> Algorithm:
     """Build the algorithm the config names (registry analog of
-    reference algorithm.go:304-313). PROPORTIONAL_SHARE with parameter
-    variant=topup selects the Go-style equal-share-plus-top-up form."""
-    if (
-        config.kind == pb.Algorithm.PROPORTIONAL_SHARE
-        and get_parameter(config, "variant") == "topup"
-    ):
-        return proportional_topup(config)
+    reference algorithm.go:304-313). The `variant` parameter selects
+    the portfolio lanes sharing a wire kind: PROPORTIONAL_SHARE
+    variant=topup (Go-style equal-share-plus-top-up) or
+    variant=logutil (Kelly proportional fairness); FAIR_SHARE
+    variant=maxmin (unweighted max-min) or variant=balanced (balanced
+    fairness)."""
+    variant = get_parameter(config, "variant")
+    if variant is not None:
+        factory = VARIANT_FACTORIES.get((config.kind, variant))
+        if factory is not None:
+            return factory(config)
     return _FACTORIES[config.kind](config)

@@ -43,9 +43,29 @@ __all__ = [
     "proportional_topup_snapshot",
     "fair_share_waterfill",
     "waterfill_level",
+    "waterfill_level_iterative",
+    "balanced_theta",
+    "max_min_fair_tick",
+    "balanced_fairness_tick",
+    "proportional_fairness_tick",
     "oracle_row",
     "F32_PARITY_REL_BOUND",
+    "FILL_ITERS",
+    "BALANCED_ROUNDS",
 ]
+
+# Fixed unroll bounds for the portfolio's iterative fills (device and
+# host run the SAME bounded iteration, which is what makes the parity
+# pin bit-level rather than tolerance-level). FILL_ITERS bounds the
+# fast-converging water-fill of arxiv 2310.09699 (one bottleneck
+# saturates per step at worst, so rows with up to FILL_ITERS distinct
+# saturation cascades solve exactly; deeper cascades freeze at the last
+# — still feasible — level). BALANCED_ROUNDS bounds the balanced-
+# fairness cap-peeling recursion of arxiv 1711.02880 (one ratio class
+# peels per round; unconverged rows keep slack, the documented
+# insensitivity truncation).
+FILL_ITERS = 16
+BALANCED_ROUNDS = 8
 
 
 def none_tick(wants: np.ndarray) -> np.ndarray:
@@ -188,6 +208,128 @@ def fair_share_waterfill(
     return np.minimum(wants, level * sub)
 
 
+def waterfill_level_iterative(
+    capacity: float,
+    wants: np.ndarray,
+    weights: np.ndarray,
+    iters: int = FILL_ITERS,
+) -> float:
+    """Water level by the fast-converging direct fill iteration (arxiv
+    2310.09699): start from the even split, repeatedly freeze the
+    saturated set and re-level the remainder. The level is monotonically
+    non-decreasing (frozen clients consume less than their level share),
+    so `max` IS the convergence mask: a converged row rewrites its own
+    level. Exact once every bottleneck cascade has frozen (at most one
+    new ratio class per step); truncation keeps the last — still
+    feasible — level. This is the oracle arithmetic for the
+    MAX_MIN_FAIR (weights = 1) and PROPORTIONAL_FAIRNESS (weights =
+    subclients; the Kelly dual fixpoint — on a single capacity the
+    KKT point of Σ wᵢ·log(gᵢ) s.t. Σ g ≤ C, g ≤ wants is exactly
+    min(wants, ν·w)) device lanes: solver.lanes runs the SAME bounded
+    iteration."""
+    tiny = np.finfo(np.float64).tiny
+    wants = np.asarray(wants, dtype=np.float64)
+    w = np.asarray(weights, dtype=np.float64)
+    level = capacity / max(float(np.sum(w)), tiny)
+    for _ in range(iters):
+        sat = wants <= level * w
+        sat_wants = float(np.sum(np.where(sat, wants, 0.0)))
+        unsat_w = float(np.sum(np.where(sat, 0.0, w)))
+        if unsat_w > 0:
+            level = max(level, (capacity - sat_wants) / max(unsat_w, tiny))
+    return level
+
+
+def max_min_fair_tick(capacity: float, wants: np.ndarray) -> np.ndarray:
+    """Client-granular (unweighted) max-min fairness: gets =
+    min(wants, L) at the iterative water level; subclient counts do not
+    weight the fill (that is FAIR_SHARE's semantics)."""
+    wants = np.asarray(wants, dtype=np.float64)
+    if float(np.sum(wants)) <= capacity:
+        return wants.copy()
+    level = waterfill_level_iterative(
+        capacity, wants, np.ones_like(wants)
+    )
+    return np.minimum(wants, level)
+
+
+def proportional_fairness_tick(
+    capacity: float, wants: np.ndarray, subclients: np.ndarray
+) -> np.ndarray:
+    """Weighted proportional fairness (Kelly log-utility, arxiv
+    1404.2266): maximize Σ subᵢ·log(gᵢ) subject to Σ g ≤ capacity and
+    g ≤ wants. The KKT point is min(wants, ν·sub) with the dual level ν
+    solved by the bounded fixpoint iteration — on one capacity this
+    coincides with FAIR_SHARE's weighted water-fill objective, but the
+    level arithmetic is the dual iteration, not the bisection+snap (the
+    two lanes agree to ~1 ulp when both converge; doc/algorithms.md)."""
+    wants = np.asarray(wants, dtype=np.float64)
+    sub = np.asarray(subclients, dtype=np.float64)
+    if float(np.sum(wants)) <= capacity:
+        return wants.copy()
+    level = waterfill_level_iterative(capacity, wants, sub)
+    return np.minimum(wants, level * sub)
+
+
+def balanced_theta(
+    capacity: float,
+    wants: np.ndarray,
+    weights: np.ndarray,
+    rounds: int = BALANCED_ROUNDS,
+) -> "tuple[float, np.ndarray]":
+    """Balanced-fairness binding ratio θ and the cap-fixed class mask,
+    by the recursive cap-peeling formula (arxiv 1711.02880, the
+    single-pool instantiation): shares are proportional to weights
+    (per-class job counts), scaled by the MOST binding constraint —
+    the pool (θ = Σx/Ĉ) or some class's rate cap (θ = xᵢ/wantsᵢ).
+    Each round the classes achieving the max cap ratio freeze at their
+    wants and leave the recursion (exactly one ratio class per round,
+    mirroring the paper's one-job-removal recursion); the pool ratio
+    takes over when it dominates — the convergence mask is the peel
+    set emptying. Truncation after `rounds` leaves capacity unclaimed
+    (the insensitivity tax; documented, and why BALANCED_FAIRNESS
+    carries no Pareto-efficiency invariant)."""
+    tiny = np.finfo(np.float64).tiny
+    wants = np.asarray(wants, dtype=np.float64)
+    x = np.asarray(weights, dtype=np.float64)
+    fixed = np.zeros(wants.shape, dtype=bool)
+    remcap = float(capacity)
+
+    def ratios(fixed):
+        live = ~fixed
+        X = float(np.sum(np.where(live, x, 0.0)))
+        cap_ratio = X / max(remcap, tiny)
+        ratio = np.where(
+            live & (wants > 0), x / np.maximum(wants, tiny), 0.0
+        )
+        return cap_ratio, ratio, float(np.max(ratio, initial=0.0))
+
+    for _ in range(rounds):
+        cap_ratio, ratio, max_ratio = ratios(fixed)
+        if max_ratio > cap_ratio:
+            peel = (~fixed) & (wants > 0) & (ratio >= max_ratio)
+            fixed = fixed | peel
+            remcap = remcap - float(np.sum(np.where(peel, wants, 0.0)))
+    cap_ratio, _ratio, max_ratio = ratios(fixed)
+    return max(cap_ratio, max_ratio), fixed
+
+
+def balanced_fairness_tick(
+    capacity: float, wants: np.ndarray, subclients: np.ndarray
+) -> np.ndarray:
+    """Balanced fairness for one pool: cap-fixed classes get their
+    wants; the rest get their weight share xᵢ/θ at the final binding
+    ratio (clamped at wants — θ is not monotone across rounds)."""
+    tiny = np.finfo(np.float64).tiny
+    wants = np.asarray(wants, dtype=np.float64)
+    x = np.asarray(subclients, dtype=np.float64)
+    if float(np.sum(wants)) <= capacity:
+        return wants.copy()
+    theta, fixed = balanced_theta(capacity, wants, x)
+    nu = 1.0 / max(theta, tiny)
+    return np.where(fixed, wants, np.minimum(wants, x * nu))
+
+
 # The ONE f32 parity bound (BASELINE.md "parity ladder"): the f32 /
 # pallas solve must stay within this of the f64 oracles, relative to the
 # row's grant scale. Enforced off-chip by tests/test_f32_parity.py and
@@ -220,4 +362,10 @@ def oracle_row(
         )
     if kind == AlgoKind.FAIR_SHARE:
         return fair_share_waterfill(capacity, wants, subclients)
+    if kind == AlgoKind.MAX_MIN_FAIR:
+        return max_min_fair_tick(capacity, wants)
+    if kind == AlgoKind.BALANCED_FAIRNESS:
+        return balanced_fairness_tick(capacity, wants, subclients)
+    if kind == AlgoKind.PROPORTIONAL_FAIRNESS:
+        return proportional_fairness_tick(capacity, wants, subclients)
     raise ValueError(f"no scalar oracle for algorithm lane {kind}")

@@ -153,7 +153,11 @@ def intermediate_partition() -> FaultPlan:
     )
 
 
-def master_flap_warm() -> FaultPlan:
+def master_flap_warm(
+    name: str = "master_flap_warm",
+    algorithm: "str | None" = None,
+    variant: "str | None" = None,
+) -> FaultPlan:
     """master_flap with persistence enabled (a shared snapshot+journal
     backend): the master's etcd view browns out past the lock TTL, it
     steps down CLEANLY (terminal journal marker), and the standby that
@@ -164,11 +168,23 @@ def master_flap_warm() -> FaultPlan:
     grants never above capacity (the `restore_capacity` invariant), and
     reconvergence within 2 ticks of the heal — the budget that makes
     warm takeover observable: it is 1/5th of the learning window the
-    cold path would need before serving real grants again."""
+    cold path would need before serving real grants again.
+
+    `algorithm`/`variant` parametrize the scenario over the fairness
+    portfolio (PLANS ships one per lane): the restore/learning-mode
+    decisions and the reconvergence SLO are algorithm-independent
+    CONTRACTS, so every lane must meet the same budgets — and each
+    parametrization's event log is pinned deterministic per kind by
+    tests/test_chaos_smoke.py."""
+    setup_extra = {}
+    if algorithm is not None:
+        setup_extra["algorithm"] = algorithm
+    if variant is not None:
+        setup_extra["algorithm_variant"] = variant
     return FaultPlan(
-        name="master_flap_warm",
+        name=name,
         seed=5,
-        setup={
+        setup=setup_extra | {
             "servers": 2,
             "clients": 3,
             "wants": [20.0, 30.0, 60.0],
@@ -292,9 +308,33 @@ def shard_partition() -> FaultPlan:
     )
 
 
+def _warm_variant(name, algorithm, variant):
+    def build():
+        return master_flap_warm(
+            name=name, algorithm=algorithm, variant=variant
+        )
+
+    return build
+
+
 PLANS: Dict[str, "callable"] = {
     "master_flap": master_flap,
     "master_flap_warm": master_flap_warm,
+    # The warm-takeover arc across the fairness portfolio: same faults,
+    # same reconvergence budget, one plan per algorithm lane
+    # (FAIR_SHARE rides the plain fair-share plan below).
+    "master_flap_warm_fair": _warm_variant(
+        "master_flap_warm_fair", "FAIR_SHARE", None
+    ),
+    "master_flap_warm_maxmin": _warm_variant(
+        "master_flap_warm_maxmin", "FAIR_SHARE", "maxmin"
+    ),
+    "master_flap_warm_balanced": _warm_variant(
+        "master_flap_warm_balanced", "FAIR_SHARE", "balanced"
+    ),
+    "master_flap_warm_logutil": _warm_variant(
+        "master_flap_warm_logutil", "PROPORTIONAL_SHARE", "logutil"
+    ),
     "client_storm": client_storm,
     "etcd_brownout": etcd_brownout,
     "device_tunnel_outage": device_tunnel_outage,

@@ -222,6 +222,16 @@ class ChaosRunner:
         s = self.plan.setup
         safe = s.get("safe_capacity")
         safe_line = f"  safe_capacity: {safe}\n" if safe is not None else ""
+        # algorithm_variant selects a portfolio lane sharing a wire
+        # kind (e.g. FAIR_SHARE + maxmin -> MAX_MIN_FAIR); it rides the
+        # config's `variant` parameter like any real deployment would.
+        variant = s.get("algorithm_variant")
+        variant_part = (
+            ", parameters: [{name: variant, value: "
+            f"{variant}" "}]"
+            if variant
+            else ""
+        )
         return (
             "resources:\n"
             f"- identifier_glob: \"*\"\n"
@@ -232,6 +242,7 @@ class ChaosRunner:
             + f"lease_length: {s.get('lease_length', 60)}, "
             + f"refresh_interval: {s.get('refresh_interval', 1)}, "
             + f"learning_mode_duration: {s.get('learning_mode_duration', 3)}"
+            + variant_part
             + "}\n"
         )
 

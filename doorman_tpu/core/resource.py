@@ -20,16 +20,35 @@ from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.algorithms.kinds import AlgoKind
 
 
+# variant -> internal lane, per wire kind (the config-epoch seam: a
+# variant flip re-maps the kind vector, which the solver's config
+# mirror detects exactly like a wire-kind change).
+_VARIANT_LANES = {
+    (int(pb.Algorithm.PROPORTIONAL_SHARE), "topup"):
+        int(AlgoKind.PROPORTIONAL_TOPUP),
+    (int(pb.Algorithm.PROPORTIONAL_SHARE), "logutil"):
+        int(AlgoKind.PROPORTIONAL_FAIRNESS),
+    (int(pb.Algorithm.FAIR_SHARE), "maxmin"):
+        int(AlgoKind.MAX_MIN_FAIR),
+    (int(pb.Algorithm.FAIR_SHARE), "balanced"):
+        int(AlgoKind.BALANCED_FAIRNESS),
+}
+
+
 def algo_kind_for(template: pb.ResourceTemplate) -> int:
-    """Map a config template to the solver lane. PROPORTIONAL_SHARE with
-    parameter variant=topup selects the Go-style top-up lane; the wire
-    PRIORITY_BANDS kind maps to its internal lane id (the wire value
-    collides with the internal top-up lane number)."""
+    """Map a config template to the solver lane. The `variant`
+    parameter refines PROPORTIONAL_SHARE (topup = Go-style top-up,
+    logutil = Kelly proportional fairness) and FAIR_SHARE (maxmin =
+    unweighted max-min, balanced = balanced fairness) into their
+    portfolio lanes; the wire PRIORITY_BANDS kind maps to its internal
+    lane id (the wire value collides with the internal top-up lane
+    number)."""
     kind = int(template.algorithm.kind)
-    if kind == int(pb.Algorithm.PROPORTIONAL_SHARE) and (
-        scalar.get_parameter(template.algorithm, "variant") == "topup"
-    ):
-        return int(AlgoKind.PROPORTIONAL_TOPUP)
+    variant = scalar.get_parameter(template.algorithm, "variant")
+    if variant is not None:
+        lane = _VARIANT_LANES.get((kind, variant))
+        if lane is not None:
+            return lane
     if kind == int(pb.Algorithm.PRIORITY_BANDS):
         return int(AlgoKind.PRIORITY_BANDS)
     return kind

@@ -30,6 +30,19 @@ Share math per algorithm lane (doc/federation.md derives these):
     clients preserves the level exactly), and each shard's share is its
     own curve evaluated at L. The local water-fill then re-derives a
     level within 1 ulp of L, so grants match the single root to 1 ulp.
+  * MAX_MIN_FAIR / PROPORTIONAL_FAIRNESS — same curve decomposition,
+    but the global level comes from the lane's OWN bounded fill
+    iteration (algorithms.tick.waterfill_level_iterative) so the level
+    a shard re-derives locally is the reconciler's: MAX_MIN_FAIR's
+    curve aggregates client-granular (weight 1), PROPORTIONAL_FAIRNESS
+    by wants/subclients.
+  * BALANCED_FAIRNESS — the bounded cap-peeling recursion
+    (algorithms.tick.balanced_theta) runs over the merged
+    pseudo-clients; a shard's share is the sum of its own clients'
+    balanced grants (wants when cap-fixed, weight/θ otherwise). The
+    local recursion re-peels the shard's restriction of the global
+    fixed set, recovering the global allocation whenever it converges
+    within BALANCED_ROUNDS.
 
 Failure containment: a shard the reconciler cannot reach keeps serving
 its LAST granted share until that share's expiry (the share is installed
@@ -51,7 +64,11 @@ from typing import Dict, Optional, Set, Tuple
 import numpy as np
 
 from doorman_tpu.algorithms.kinds import AlgoKind
-from doorman_tpu.algorithms.tick import waterfill_level
+from doorman_tpu.algorithms.tick import (
+    balanced_theta,
+    waterfill_level,
+    waterfill_level_iterative,
+)
 
 __all__ = [
     "ShardSummary",
@@ -69,7 +86,16 @@ CAPACITY_SPLIT_KINDS = frozenset({
     int(AlgoKind.PROPORTIONAL_SHARE),
     int(AlgoKind.PROPORTIONAL_TOPUP),
     int(AlgoKind.FAIR_SHARE),
+    int(AlgoKind.MAX_MIN_FAIR),
+    int(AlgoKind.BALANCED_FAIRNESS),
+    int(AlgoKind.PROPORTIONAL_FAIRNESS),
 })
+
+# Lanes whose breakpoint curve aggregates by the CLIENT-granular ratio
+# wants/1 rather than wants/subclients: MAX_MIN_FAIR's fill ignores
+# subclient weights, so merging by the weighted ratio would fuse
+# clients that saturate at different levels.
+_UNWEIGHTED_KINDS = frozenset({int(AlgoKind.MAX_MIN_FAIR)})
 
 
 @dataclass(frozen=True)
@@ -97,18 +123,23 @@ class ShardSummary:
         return total
 
 
-def summarize_resource(resource, shard: int) -> ShardSummary:
+def summarize_resource(
+    resource, shard: int, kind: "int | None" = None
+) -> ShardSummary:
     """Build a shard's summary from its live store rows. The caller
     sweeps expiries first (store.clean()) so lapsed leases do not haunt
     the demand curve; dump_rows is the stores' bulk drain (one C call on
-    the native engine)."""
+    the native engine). `kind` selects the lane's weighting for the
+    breakpoint curve: MAX_MIN_FAIR aggregates client-granular (weight
+    1 per client); the weighted lanes aggregate by wants/subclients."""
+    unweighted = kind is not None and int(kind) in _UNWEIGHTED_KINDS
     by_ratio: Dict[float, list] = {}
     wants_sum = 0.0
     has_sum = 0.0
     weight_sum = 0.0
     for (_client, _expiry, _refresh, has, wants, subclients,
          _priority) in resource.store.dump_rows():
-        weight = float(subclients) or 1.0
+        weight = 1.0 if unweighted else (float(subclients) or 1.0)
         ratio = wants / weight
         acc = by_ratio.setdefault(ratio, [0.0, 0.0])
         acc[0] += wants
@@ -225,6 +256,13 @@ class StraddleReconciler:
             }
         if self.kind == int(AlgoKind.FAIR_SHARE):
             return self._fair_shares(summaries, pool)
+        if self.kind in (
+            int(AlgoKind.MAX_MIN_FAIR),
+            int(AlgoKind.PROPORTIONAL_FAIRNESS),
+        ):
+            return self._level_shares(summaries, pool)
+        if self.kind == int(AlgoKind.BALANCED_FAIRNESS):
+            return self._balanced_shares(summaries, pool)
         # Proportional lanes: the global scale factor, distributed so
         # each local solve recovers it (c_s / W_s == pool / total up to
         # the quotient round-trip).
@@ -232,9 +270,13 @@ class StraddleReconciler:
         shares = {s.shard: s.wants * prop for s in summaries}
         return self._clamp(shares, pool)
 
-    def _fair_shares(self, summaries, pool: float) -> Dict[int, float]:
-        """Exact global water level over the merged breakpoint curves,
-        then each shard's share is its own curve at that level."""
+    @staticmethod
+    def _merged(summaries):
+        """Flat (wants, weights, shard-slice) arrays over every shard's
+        breakpoint pseudo-clients. A pseudo-client is exact for every
+        portfolio fill: its saturation test W <= L·U is equivalent to
+        the common per-client ratio r <= L, and its sums enter the
+        level updates exactly as the per-client sums do."""
         wants = np.array(
             [w for s in summaries for (_r, w, _wt) in s.breakpoints],
             np.float64,
@@ -243,11 +285,62 @@ class StraddleReconciler:
             [wt for s in summaries for (_r, _w, wt) in s.breakpoints],
             np.float64,
         )
+        slices = []
+        pos = 0
+        for s in summaries:
+            n = len(s.breakpoints)
+            slices.append(slice(pos, pos + n))
+            pos += n
+        return wants, weights, slices
+
+    def _fair_shares(self, summaries, pool: float) -> Dict[int, float]:
+        """Exact global water level over the merged breakpoint curves,
+        then each shard's share is its own curve at that level."""
+        wants, weights, _slices = self._merged(summaries)
         if wants.size == 0:
             return {s.shard: pool / len(summaries) for s in summaries}
         level = waterfill_level(pool, wants, weights)
         shares = {
             s.shard: s.demand_at_level(level) for s in summaries
+        }
+        return self._clamp(shares, pool)
+
+    def _level_shares(self, summaries, pool: float) -> Dict[int, float]:
+        """MAX_MIN_FAIR / PROPORTIONAL_FAIRNESS: the global level from
+        the lane's OWN bounded fill iteration over the merged
+        pseudo-clients (matching the local solves' arithmetic, so the
+        level each shard re-derives from its share is the global one to
+        ~1 ulp), then each shard's share is its curve at that level.
+        MAX_MIN_FAIR's curve is client-granular (weight 1; see
+        summarize_resource), so one demand_at_level serves both."""
+        wants, weights, _slices = self._merged(summaries)
+        if wants.size == 0:
+            return {s.shard: pool / len(summaries) for s in summaries}
+        level = waterfill_level_iterative(pool, wants, weights)
+        shares = {
+            s.shard: s.demand_at_level(level) for s in summaries
+        }
+        return self._clamp(shares, pool)
+
+    def _balanced_shares(self, summaries, pool: float) -> Dict[int, float]:
+        """BALANCED_FAIRNESS: run the bounded cap-peeling recursion
+        over the merged pseudo-clients to get the global binding ratio
+        θ and the cap-fixed set, then each shard's share is the sum of
+        its own pseudo-clients' balanced grants — wants when fixed,
+        min(wants, weight/θ) otherwise. The local recursion at that
+        share re-peels the shard's restriction of the fixed set (same
+        ratios, fewer classes per round), recovering the global
+        allocation whenever it converges within BALANCED_ROUNDS."""
+        tiny = np.finfo(np.float64).tiny
+        wants, weights, slices = self._merged(summaries)
+        if wants.size == 0:
+            return {s.shard: pool / len(summaries) for s in summaries}
+        theta, fixed = balanced_theta(pool, wants, weights)
+        nu = 1.0 / max(theta, tiny)
+        gets = np.where(fixed, wants, np.minimum(wants, weights * nu))
+        shares = {
+            s.shard: float(np.sum(gets[slices[i]]))
+            for i, s in enumerate(summaries)
         }
         return self._clamp(shares, pool)
 

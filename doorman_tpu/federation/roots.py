@@ -121,7 +121,9 @@ class FederatedRoots:
                     res = server.resources.get(rid)
                     if res is not None:
                         res.store.clean()
-                        summaries[shard] = summarize_resource(res, shard)
+                        summaries[shard] = summarize_resource(
+                            res, shard, kind=rec.kind
+                        )
                     else:
                         from doorman_tpu.federation.reconcile import (
                             ShardSummary,

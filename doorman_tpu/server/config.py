@@ -90,6 +90,24 @@ def validate_algorithm(algo: pb.Algorithm) -> None:
         raise ConfigError("invalid lease length, must be at least 1 second")
     if algo.lease_length < algo.refresh_interval:
         raise ConfigError("lease length must be larger than the refresh interval")
+    # A `variant` parameter must name a known refinement of its wire
+    # kind (algorithms.scalar.VARIANT_FACTORIES): a typo would silently
+    # select the base lane, and — because algo_kind_for feeds the
+    # solver's config mirror — flip the device lane set on a later fix,
+    # so fail the config epoch loudly instead.
+    from doorman_tpu.algorithms.scalar import VARIANT_FACTORIES, get_parameter
+
+    variant = get_parameter(algo, "variant")
+    if variant is not None and (algo.kind, variant) not in VARIANT_FACTORIES:
+        known = sorted(
+            v for (k, v) in VARIANT_FACTORIES if k == algo.kind
+        )
+        raise ConfigError(
+            f"unknown variant {variant!r} for algorithm "
+            f"{pb.Algorithm.Kind.Name(algo.kind)}"
+            + (f" (known: {', '.join(known)})" if known else
+               " (this kind has no variants)")
+        )
 
 
 def validate_repository(repo: pb.ResourceRepository) -> None:

@@ -11,7 +11,11 @@ import logging
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="doorman-tpu simulation")
-    parser.add_argument("scenario", choices=list("1234567") + ["all"])
+    from doorman_tpu.sim.scenarios import SCENARIOS
+
+    parser.add_argument(
+        "scenario", choices=sorted(SCENARIOS) + ["all"]
+    )
     parser.add_argument("--run-for", type=float, default=None)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--csv", action="store_true", help="write CSV report")

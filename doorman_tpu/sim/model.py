@@ -58,6 +58,20 @@ class SimConfig:
         t.algorithm.refresh_interval = 8
         return cls(repo)
 
+    @classmethod
+    def portfolio(cls, wire_kind: int, variant: "str | None") -> "SimConfig":
+        """The default config re-pointed at a fairness-portfolio lane
+        (doc/algorithms.md "The fairness portfolio"): same capacity /
+        lease shape, the algorithm selected by wire kind + `variant`
+        parameter — the sim-side half of the per-algorithm scenario
+        diversity (chaos parametrizes master_flap_warm the same way)."""
+        cfg = cls.default()
+        algo = cfg.repository.resources[0].algorithm
+        algo.kind = int(wire_kind)
+        if variant is not None:
+            algo.parameters.add(name="variant", value=variant)
+        return cfg
+
     def find(self, resource_id: str) -> Optional[pb.ResourceTemplate]:
         return find_template(self.repository, resource_id)
 

@@ -138,6 +138,28 @@ def scenario_seven(sim: Sim, reporter: Reporter) -> None:
     sim.scheduler.add_absolute(60, random_mishap)
 
 
+def _scenario_one_lane(wire_kind: str, variant: "str | None"):
+    """scenario_one re-pointed at a fairness-portfolio lane: the same
+    convergence arc (5 clients, fluctuating demand, one 110-capacity
+    pool in overload) must hold whichever lane apportions it — the
+    sim-side half of the per-algorithm scenario diversity."""
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.sim.model import SimConfig
+
+    def scenario(sim: Sim, reporter: Reporter) -> None:
+        config = SimConfig.portfolio(
+            getattr(pb.Algorithm, wire_kind), variant
+        )
+        job = ServerJob(sim, "root", 0, 3, config=config)
+        for _ in range(5):
+            c = SimClient(sim, "client", job)
+            c.add_resource("resource0", 0, 110, 0.1, 10)
+        reporter.schedule("resource0")
+        reporter.set_filename(f"scenario_one_{variant or 'fair'}")
+
+    return scenario
+
+
 SCENARIOS: Dict[str, Callable[[Sim, Reporter], None]] = {
     "1": scenario_one,
     "2": scenario_two,
@@ -146,6 +168,11 @@ SCENARIOS: Dict[str, Callable[[Sim, Reporter], None]] = {
     "5": scenario_five,
     "6": scenario_six,
     "7": scenario_seven,
+    # The fairness portfolio over the scenario-one convergence arc.
+    "1_fair": _scenario_one_lane("FAIR_SHARE", None),
+    "1_maxmin": _scenario_one_lane("FAIR_SHARE", "maxmin"),
+    "1_balanced": _scenario_one_lane("FAIR_SHARE", "balanced"),
+    "1_logutil": _scenario_one_lane("PROPORTIONAL_SHARE", "logutil"),
 }
 
 DEFAULT_DURATION: Dict[str, float] = {"7": 3600.0}

@@ -23,6 +23,7 @@ import numpy as np
 from dataclasses import dataclass
 
 from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.solver.lanes import ITERATIVE_KINDS
 from doorman_tpu.core.resource import Resource, algo_kind_for, static_param
 from doorman_tpu.obs.phases import PhaseRecorder
 from doorman_tpu.core.snapshot import (
@@ -41,14 +42,17 @@ from doorman_tpu.utils.transfer import chunked_device_get
 DENSE_MAX_K = 4096
 
 
-def _dense_solver(use_pallas: bool, lanes=None, with_fair: bool = False):
+def _dense_solver(use_pallas: bool, lanes=None, iter_kinds: tuple = ()):
     """Jitted dense solve with the output sliced to the filled extent
     inside the same executable (one dispatch, download-sized output).
-    `lanes`/`with_fair` are the host-knowledge fast paths of
-    solver.lanes (skip absent algorithm lanes; water-fill only the
-    FAIR_SHARE rows) — byte-identical to the full solve; the pallas
-    kernel ignores them (its fused body computes all lanes in VMEM)."""
-    key = (use_pallas, lanes, with_fair)
+    `lanes`/`iter_kinds` are the host-knowledge fast paths of
+    solver.lanes (skip absent algorithm lanes; restrict each iterative
+    fill — FAIR_SHARE's bisection and the fairness portfolio's bounded
+    iterations — to its own rows) — byte-identical to the full solve;
+    the pallas kernel ignores them (its fused body computes all lanes
+    in VMEM). `iter_kinds` is the static tuple of AlgoKind ints whose
+    row sets ride the `lane_rows` dict argument."""
+    key = (use_pallas, lanes, iter_kinds)
     fn = _dense_solvers.get(key)
     if fn is None:
         from functools import partial
@@ -57,17 +61,17 @@ def _dense_solver(use_pallas: bool, lanes=None, with_fair: bool = False):
             from doorman_tpu.solver.pallas_dense import solve_dense_pallas
 
             @partial(jax.jit, static_argnums=(1, 2))
-            def fn(dense, n_rows, kfill, fair_rows=None):
+            def fn(dense, n_rows, kfill, lane_rows=None):
                 return solve_dense_pallas(dense)[:n_rows, :kfill]
 
         else:
             from doorman_tpu.solver.dense import solve_dense
 
             @partial(jax.jit, static_argnums=(1, 2))
-            def fn(dense, n_rows, kfill, fair_rows=None):
+            def fn(dense, n_rows, kfill, lane_rows=None):
                 return solve_dense(
                     dense, lanes=lanes,
-                    fair_rows=fair_rows if with_fair else None,
+                    lane_rows=lane_rows if iter_kinds else None,
                 )[:n_rows, :kfill]
 
         _dense_solvers[key] = fn
@@ -349,15 +353,16 @@ class BatchSolver:
             dense_fill=(n_rows, kfill),
         )
         # Host lane knowledge for the solve (solver.lanes fast paths):
-        # the specs name every algorithm kind present, and the fair rows
-        # pad to a bucketed static shape (repeats are harmless).
+        # the specs name every algorithm kind present, and each
+        # iterative lane's rows pad to a bucketed static shape (repeats
+        # are harmless) so its fill runs only over its own rows.
         snap.dense_lanes = frozenset(int(k) for k in np.unique(kind[:n_spec]))
-        fair = np.nonzero(
-            kind[:n_spec] == int(AlgoKind.FAIR_SHARE)
-        )[0].astype(np.int32)
-        snap.dense_fair = (
-            np.resize(fair, _bucket(len(fair), 8)) if len(fair) else None
-        )
+        iter_rows = {}
+        for k in sorted(ITERATIVE_KINDS & snap.dense_lanes):
+            rows = np.nonzero(kind[:n_spec] == int(k))[0].astype(np.int32)
+            if len(rows):
+                iter_rows[k] = np.resize(rows, _bucket(len(rows), 8))
+        snap.dense_iter = iter_rows or None
         return snap
 
     def _snapshot_priority(
@@ -508,10 +513,11 @@ class BatchSolver:
             )
             n_rows, kfill = snap.dense_fill
             lanes = getattr(snap, "dense_lanes", None)
-            fair = getattr(snap, "dense_fair", None)
+            iter_rows = getattr(snap, "dense_iter", None)
             dense_gets = _dense_solver(
-                use_pallas, lanes, fair is not None
-            )(snap.dense, n_rows, kfill, fair)
+                use_pallas, lanes,
+                tuple(sorted(iter_rows)) if iter_rows else (),
+            )(snap.dense, n_rows, kfill, iter_rows)
             got = chunked_device_get(dense_gets)
             gets = got[snap.ridx, snap.pos]
         else:

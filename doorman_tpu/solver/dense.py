@@ -41,14 +41,19 @@ class DenseBatch:
     static_capacity: jax.Array  # [R]
 
 
-def solve_dense(batch: DenseBatch, lanes=None, fair_rows=None) -> jax.Array:
+def solve_dense(
+    batch: DenseBatch, lanes=None, fair_rows=None, lane_rows=None
+) -> jax.Array:
     """Grants [R, K]; same lane semantics as kernels.solve_edges.
 
     `lanes` (a frozenset of AlgoKind ints present in the batch) and
     `fair_rows` (the FAIR_SHARE row indices, padded to a static shape)
     are the host-knowledge fast paths of solve_lanes: absent lanes are
     skipped and the water-fill bisection runs only over the fair rows —
-    both byte-identical to the default full computation."""
+    both byte-identical to the default full computation. `lane_rows`
+    ({int(AlgoKind): row indices}) extends the row restriction to every
+    iterative lane of the fairness portfolio (solver.lanes
+    ITERATIVE_KINDS) the same way."""
     return solve_lanes(
         batch.wants,
         batch.has,
@@ -63,6 +68,7 @@ def solve_dense(batch: DenseBatch, lanes=None, fair_rows=None) -> jax.Array:
         expand=lambda totals: totals[:, None],
         lanes=lanes,
         fair_rows=fair_rows,
+        lane_rows=lane_rows,
     )
 
 

@@ -22,8 +22,19 @@ import jax
 import jax.numpy as jnp
 
 from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.algorithms.tick import BALANCED_ROUNDS, FILL_ITERS
 
 _REFINE_ITERS = 2
+
+# The lanes whose fill is an iterative per-row computation in the row
+# layout: row-layout callers may restrict each to its own rows via the
+# compact gather→solve→scatter (lane_rows / fair_rows below).
+ITERATIVE_KINDS = frozenset({
+    int(AlgoKind.FAIR_SHARE),
+    int(AlgoKind.MAX_MIN_FAIR),
+    int(AlgoKind.BALANCED_FAIRNESS),
+    int(AlgoKind.PROPORTIONAL_FAIRNESS),
+})
 
 
 def _bisect_iters(dtype) -> int:
@@ -117,6 +128,146 @@ def waterfill_level_compact(
     return jnp.zeros_like(capacity).at[fair_rows].set(lvl, mode="drop")
 
 
+def iterfill_level(
+    wants: jax.Array,  # lease-shaped, masked (inactive -> 0)
+    weights: jax.Array,  # lease-shaped, masked
+    capacity: jax.Array,  # per-resource
+    segsum: Reduce,
+    expand: Expand,
+) -> jax.Array:
+    """Per-resource water level by the fast-converging direct fill
+    iteration (arxiv 2310.09699; oracle arithmetic in
+    algorithms.tick.waterfill_level_iterative — expression-for-
+    expression the same update, which is what pins the MAX_MIN_FAIR /
+    PROPORTIONAL_FAIRNESS lanes to their host references): start at
+    the even split, freeze the saturated set, re-level the remainder.
+    The level is monotone non-decreasing, so `maximum` doubles as the
+    convergence mask; FILL_ITERS bounds the unroll (one bottleneck
+    cascade freezes per step at worst; deeper cascades keep the last —
+    still feasible — level). Only meaningful for overloaded rows; the
+    caller's fits-where never selects the underloaded ones."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    tiny = jnp.finfo(dtype).tiny
+    level = capacity / jnp.maximum(segsum(weights), tiny)
+
+    def body(_, level):
+        sat = wants <= expand(level) * weights
+        sat_wants = segsum(jnp.where(sat, wants, zero))
+        unsat_w = segsum(jnp.where(sat, zero, weights))
+        level_new = (capacity - sat_wants) / jnp.maximum(unsat_w, tiny)
+        return jnp.where(
+            unsat_w > 0, jnp.maximum(level, level_new), level
+        )
+
+    return jax.lax.fori_loop(0, FILL_ITERS, body, level)
+
+
+def iterfill_level_compact(
+    wants: jax.Array,  # [R, K], row layout
+    weights: jax.Array,  # [R, K]
+    capacity: jax.Array,  # [R]
+    rows: jax.Array,  # [F] int32 rows running this lane (repeats ok)
+) -> jax.Array:
+    """Row-layout iterative fill restricted to this lane's rows — the
+    same gather→solve→scatter as waterfill_level_compact, bit-identical
+    per row by per-row independence. Non-selected rows read level 0,
+    which no lane consumes."""
+    lvl = iterfill_level(
+        jnp.take(wants, rows, axis=0),
+        jnp.take(weights, rows, axis=0),
+        jnp.take(capacity, rows, axis=0),
+        segsum=lambda v: v.sum(axis=1),
+        expand=lambda totals: totals[:, None],
+    )
+    return jnp.zeros_like(capacity).at[rows].set(lvl, mode="drop")
+
+
+def balanced_fill(
+    wants: jax.Array,  # lease-shaped, masked
+    weights: jax.Array,  # lease-shaped, masked (subclients)
+    active: jax.Array,  # lease-shaped bool
+    capacity: jax.Array,  # per-resource
+    segsum: Reduce,
+    segmax: Reduce,
+    expand: Expand,
+) -> jax.Array:
+    """Balanced-fairness grants by the recursive cap-peeling formula
+    (arxiv 1711.02880 single-pool instantiation; oracle arithmetic in
+    algorithms.tick.balanced_theta): shares proportional to weights,
+    scaled by the most binding constraint ratio θ; each round the
+    classes at the max cap ratio freeze at their wants and leave the
+    recursion — the peel condition compares ratios to their own segmax,
+    so the argmax class peels by exact float equality (guaranteed
+    progress, no epsilon). BALANCED_ROUNDS bounds the unroll; an
+    unconverged row leaves capacity unclaimed (the insensitivity
+    truncation — still feasible, documented in doc/algorithms.md).
+    Overload form only; the caller's fits-where handles underload."""
+    dtype = wants.dtype
+    zero = jnp.zeros((), dtype)
+    one = jnp.ones((), dtype)
+    tiny = jnp.finfo(dtype).tiny
+    live0 = jnp.where(active, one, zero)
+
+    def ratios(fixed, remcap):
+        livef = jnp.where(fixed > 0, zero, live0)
+        X = segsum(livef * weights)
+        cap_ratio = X / jnp.maximum(remcap, tiny)
+        ratio = jnp.where(
+            (livef > 0) & (wants > 0),
+            weights / jnp.maximum(wants, tiny),
+            zero,
+        )
+        # Chunked segment_max yields the dtype minimum for empty
+        # (padding) segments; ratios are >= 0, clamp.
+        max_ratio = jnp.maximum(segmax(ratio), zero)
+        return cap_ratio, ratio, max_ratio
+
+    def body(_, carry):
+        fixed, remcap = carry
+        cap_ratio, ratio, max_ratio = ratios(fixed, remcap)
+        peel = (ratio >= expand(max_ratio)) & expand(
+            max_ratio > cap_ratio
+        )
+        fixed = jnp.where(peel, one, fixed)
+        remcap = remcap - segsum(jnp.where(peel, wants, zero))
+        return fixed, remcap
+
+    fixed, remcap = jax.lax.fori_loop(
+        0, BALANCED_ROUNDS, body, (jnp.zeros_like(wants), capacity)
+    )
+    cap_ratio, _ratio, max_ratio = ratios(fixed, remcap)
+    theta = jnp.maximum(cap_ratio, max_ratio)
+    nu = one / jnp.maximum(theta, tiny)
+    return jnp.where(
+        fixed > 0, wants, jnp.minimum(wants, weights * expand(nu))
+    )
+
+
+def balanced_fill_compact(
+    wants: jax.Array,  # [R, K], row layout
+    weights: jax.Array,  # [R, K]
+    active: jax.Array,  # [R, K] bool
+    capacity: jax.Array,  # [R]
+    rows: jax.Array,  # [F] int32 rows running this lane (repeats ok)
+) -> jax.Array:
+    """Row-layout balanced fill restricted to this lane's rows: gather,
+    run the bounded recursion on the [F, K] subtable, scatter the GRANT
+    rows back (the recursion's fixed mask is lease-shaped, so the
+    scatter carries whole grant rows; duplicates write the same row).
+    Non-selected rows read grant 0, which no lane consumes."""
+    gets = balanced_fill(
+        jnp.take(wants, rows, axis=0),
+        jnp.take(weights, rows, axis=0),
+        jnp.take(active, rows, axis=0),
+        jnp.take(capacity, rows, axis=0),
+        segsum=lambda v: v.sum(axis=1),
+        segmax=lambda v: v.max(axis=1),
+        expand=lambda totals: totals[:, None],
+    )
+    return jnp.zeros_like(wants).at[rows].set(gets, mode="drop")
+
+
 def solve_lanes(
     wants: jax.Array,  # lease-shaped
     has: jax.Array,
@@ -131,6 +282,7 @@ def solve_lanes(
     expand: Expand,
     lanes: "Optional[frozenset]" = None,
     fair_rows: "Optional[jax.Array]" = None,
+    lane_rows: "Optional[dict]" = None,
 ) -> jax.Array:
     """Grants, lease-shaped; inactive lanes produce 0.
 
@@ -146,7 +298,14 @@ def solve_lanes(
     `fair_rows`: row-layout callers (one row = one resource) may pass
     the FAIR_SHARE row indices to restrict the water-fill bisection to
     those rows (waterfill_level_compact — bit-identical per row).
-    Ignored unless the FAIR_SHARE lane runs."""
+    Ignored unless the FAIR_SHARE lane runs.
+
+    `lane_rows`: the generalization of `fair_rows` to the whole
+    iterative portfolio — {int(AlgoKind): [F] row indices} restricting
+    each ITERATIVE_KINDS lane's fill to its own rows via the same
+    compact gather→solve→scatter. `fair_rows` folds in as the
+    FAIR_SHARE entry. Row-layout callers only; entries for lanes not
+    in `lanes` are ignored."""
     dtype = wants.dtype
     zero = jnp.zeros((), dtype)
     tiny = jnp.finfo(dtype).tiny
@@ -157,6 +316,10 @@ def solve_lanes(
 
     def need(kind_value) -> bool:
         return lanes is None or int(kind_value) in lanes
+
+    rows_of = dict(lane_rows) if lane_rows else {}
+    if fair_rows is not None:
+        rows_of.setdefault(int(AlgoKind.FAIR_SHARE), fair_rows)
 
     sum_wants = segsum(wants)  # per-resource
 
@@ -178,7 +341,13 @@ def solve_lanes(
     # `free` feeds the proportional lanes; `fits` the topup/fair lanes.
     if need(AlgoKind.PROPORTIONAL_SHARE) or need(AlgoKind.PROPORTIONAL_TOPUP):
         free = jnp.maximum(cap_e - (expand(segsum(has)) - has), zero)
-    if need(AlgoKind.PROPORTIONAL_TOPUP) or need(AlgoKind.FAIR_SHARE):
+    if (
+        need(AlgoKind.PROPORTIONAL_TOPUP)
+        or need(AlgoKind.FAIR_SHARE)
+        or need(AlgoKind.MAX_MIN_FAIR)
+        or need(AlgoKind.BALANCED_FAIRNESS)
+        or need(AlgoKind.PROPORTIONAL_FAIRNESS)
+    ):
         fits = expand(sum_wants <= capacity)
 
     # ---- Lane: PROPORTIONAL_SHARE (simulation semantics,
@@ -199,9 +368,10 @@ def solve_lanes(
 
     # ---- Lane: FAIR_SHARE — full weighted max-min water-filling.
     if need(AlgoKind.FAIR_SHARE):
-        if fair_rows is not None:
+        fair = rows_of.get(int(AlgoKind.FAIR_SHARE))
+        if fair is not None:
             level = waterfill_level_compact(
-                wants, sub, active, capacity, fair_rows
+                wants, sub, active, capacity, fair
             )
         else:
             level = waterfill_level(
@@ -210,6 +380,53 @@ def solve_lanes(
         lane_outs.append((
             AlgoKind.FAIR_SHARE,
             jnp.where(fits, wants, jnp.minimum(wants, expand(level) * sub)),
+        ))
+
+    # ---- Lane: MAX_MIN_FAIR — client-granular (unweighted) max-min by
+    # the fast-converging direct fill (arxiv 2310.09699; oracle
+    # algorithms.tick.max_min_fair_tick).
+    if need(AlgoKind.MAX_MIN_FAIR):
+        ones = jnp.where(active, jnp.ones((), dtype), zero)
+        rows = rows_of.get(int(AlgoKind.MAX_MIN_FAIR))
+        if rows is not None:
+            mm_level = iterfill_level_compact(wants, ones, capacity, rows)
+        else:
+            mm_level = iterfill_level(wants, ones, capacity, segsum, expand)
+        lane_outs.append((
+            AlgoKind.MAX_MIN_FAIR,
+            jnp.where(
+                fits, wants, jnp.minimum(wants, expand(mm_level) * ones)
+            ),
+        ))
+
+    # ---- Lane: BALANCED_FAIRNESS — recursive cap-peeling shares
+    # (arxiv 1711.02880; oracle algorithms.tick.balanced_fairness_tick).
+    if need(AlgoKind.BALANCED_FAIRNESS):
+        rows = rows_of.get(int(AlgoKind.BALANCED_FAIRNESS))
+        if rows is not None:
+            bal = balanced_fill_compact(wants, sub, active, capacity, rows)
+        else:
+            bal = balanced_fill(
+                wants, sub, active, capacity, segsum, segmax, expand
+            )
+        lane_outs.append((
+            AlgoKind.BALANCED_FAIRNESS, jnp.where(fits, wants, bal)
+        ))
+
+    # ---- Lane: PROPORTIONAL_FAIRNESS — Kelly log-utility dual
+    # fixpoint, subclient-weighted (arxiv 1404.2266; oracle
+    # algorithms.tick.proportional_fairness_tick).
+    if need(AlgoKind.PROPORTIONAL_FAIRNESS):
+        rows = rows_of.get(int(AlgoKind.PROPORTIONAL_FAIRNESS))
+        if rows is not None:
+            pf_level = iterfill_level_compact(wants, sub, capacity, rows)
+        else:
+            pf_level = iterfill_level(wants, sub, capacity, segsum, expand)
+        lane_outs.append((
+            AlgoKind.PROPORTIONAL_FAIRNESS,
+            jnp.where(
+                fits, wants, jnp.minimum(wants, expand(pf_level) * sub)
+            ),
         ))
 
     # ---- Lane: PROPORTIONAL_TOPUP (Go semantics, snapshot form,

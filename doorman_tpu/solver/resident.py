@@ -71,7 +71,6 @@ from typing import Callable, List, Sequence
 
 import numpy as np
 
-from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource
 from doorman_tpu.core.snapshot import _bucket
 from doorman_tpu.obs.phases import PhaseRecorder
@@ -89,8 +88,52 @@ from doorman_tpu.solver.engine import (
 )
 from doorman_tpu.solver.engine import _BF16
 
+from doorman_tpu.solver.lanes import ITERATIVE_KINDS
+
 # Back-compat aliases (resident_wide and tests import these from here).
 _ceil_to = ceil_to
+
+
+def _compact_iter_positions(kind_c: np.ndarray, lanes: frozenset):
+    """(clayout, concatenated position segments or None): the positions
+    of each iterative lane's rows WITHIN a compact scope table, one
+    pow2-bucketed segment per ITERATIVE_KINDS lane in `lanes` (padding
+    repeats position 0 — duplicate gathers read, and duplicate
+    scatters write, the same row). The layout is static per (lanes,
+    per-kind bucket) combination, so the scoped executable cache stays
+    bounded the same way the scope bucket itself is."""
+    present = sorted(ITERATIVE_KINDS & set(lanes))
+    if not present:
+        return (), None
+    segments = []
+    layout = []
+    off = 0
+    for k in present:
+        p = np.nonzero(kind_c == int(k))[0]
+        Lb = pow2_bucket(max(len(p), 1), 8)
+        segments.append(
+            np.resize(p, Lb).astype(np.int32)
+            if len(p)
+            else np.zeros(Lb, np.int32)
+        )
+        layout.append((int(k), off, Lb))
+        off += Lb
+    return tuple(layout), np.concatenate(segments)
+
+
+def _lane_rows_slicer(layout: tuple, lanes: frozenset):
+    """Closure slicing a placed iter-rows buffer into solve_lanes'
+    per-kind `lane_rows` dict, from the static (kind, offset, length)
+    layout — only the kinds actually in this executable's lane set
+    (absent lanes' segments would just be dead gathers)."""
+    entries = [e for e in layout if e[0] in lanes]
+    if not entries:
+        return lambda buf: None
+
+    def slice_rows(buf):
+        return {k: buf[off : off + ln] for (k, off, ln) in entries}
+
+    return slice_rows
 
 
 class ResidentOverflow(RuntimeError):
@@ -153,11 +196,16 @@ class ResidentDenseSolver(TickEngineBase):
         # [Sb]-bool changed mask rides the delivery download. None until
         # enable_delta_tracking() + the next rebuild.
         self._prev = None
-        # FAIR_SHARE row indices (device, padded; see solver.lanes
-        # waterfill_level_compact) — rebuilt when the config's kind
-        # vector moves.
-        self._fair_rows_d = None
-        self._fair_kinds = None
+        # Iterative-lane row indices (device, one padded segment per
+        # ITERATIVE_KINDS lane present — FAIR_SHARE's bisection and the
+        # fairness portfolio's bounded fills each restrict to their own
+        # rows; see solver.lanes waterfill_level_compact /
+        # iterfill_level_compact) — rebuilt when the config's kind
+        # vector moves. `_iter_layout` is the static (kind, offset,
+        # length) tuple the tick executables slice the buffer by.
+        self._iter_rows_d = None
+        self._iter_layout = ()
+        self._iter_kinds_src = None
 
     # -- build / rebuild ----------------------------------------------
 
@@ -238,7 +286,7 @@ class ResidentDenseSolver(TickEngineBase):
         )
         self._uploaded_versions = versions
         self._config.reset(self._Rp)
-        self._fair_kinds = None
+        self._iter_kinds_src = None
         self._refresh_config(rows, self._config._epoch, self._clock())
         self._just_rebuilt = True
         self._tick_fns.clear()
@@ -260,36 +308,50 @@ class ResidentDenseSolver(TickEngineBase):
             or any(a is not b for a, b in zip(resources, self._rows))
         )
 
-    def _fair_rows(self):
-        """Device array of FAIR_SHARE row indices, padded to a bucketed
-        static shape (single device: [Fb]; mesh: per-shard [n_dev, Fb]
-        shard-local blocks). A cached zeros block when no row runs
-        FAIR_SHARE — the solve never reads it then (the lane is
-        compiled away), and caching it keeps the per-tick dispatch
-        count at its floor instead of re-placing a throwaway block
-        every tick. Rebuilt when the config's kind vector object moves
-        (epoch changes)."""
+    def _iter_rows(self):
+        """(device buffer, layout) of per-lane row indices for every
+        iterative lane present (solver.lanes ITERATIVE_KINDS ∩ the
+        config's kind set), each segment padded to a bucketed static
+        shape and concatenated — single device: [ΣLb]; mesh: per-shard
+        [n_dev, ΣLb] shard-local blocks. `layout` is the static
+        (kind, offset, length) tuple; the tick executables slice the
+        buffer by it and hand solve_lanes a per-kind `lane_rows` dict,
+        so each lane's fill gathers only its own rows. A cached zeros
+        block (empty layout) when no row runs an iterative lane — the
+        solve never reads it then, and caching it keeps the per-tick
+        dispatch count at its floor instead of re-placing a throwaway
+        block every tick. Rebuilt when the config's kind vector object
+        moves (epoch changes)."""
         kind_h = self._config.kind_h
-        if kind_h is self._fair_kinds:
-            return self._fair_rows_d
-        self._fair_kinds = kind_h
-        fair = np.nonzero(
-            kind_h[: self._R] == int(AlgoKind.FAIR_SHARE)
-        )[0].astype(np.int64)
-        if not len(fair):
+        if kind_h is self._iter_kinds_src:
+            return self._iter_rows_d, self._iter_layout
+        self._iter_kinds_src = kind_h
+        present = sorted(
+            ITERATIVE_KINDS
+            & {int(k) for k in np.unique(kind_h[: self._R])}
+        )
+        if not present:
+            self._iter_layout = ()
             if self._meshrows is None:
-                self._fair_rows_d = self._put(np.zeros(8, np.int32))
+                self._iter_rows_d = self._put(np.zeros(8, np.int32))
             else:
-                self._fair_rows_d = self._put_rows(
+                self._iter_rows_d = self._put_rows(
                     np.zeros((self._meshrows.n_dev, 8), np.int32)
                 )
-            return self._fair_rows_d
+            return self._iter_rows_d, self._iter_layout
         if self._meshrows is None:
-            Fb = ceil_to(len(fair), 8)
-            self._fair_rows_d = self._put(
-                np.resize(fair, Fb).astype(np.int32)
-            )
-            return self._fair_rows_d
+            segments = []
+            layout = []
+            off = 0
+            for k in present:
+                rows = np.nonzero(kind_h[: self._R] == int(k))[0]
+                Lb = ceil_to(len(rows), 8)
+                segments.append(np.resize(rows, Lb).astype(np.int32))
+                layout.append((int(k), off, Lb))
+                off += Lb
+            self._iter_layout = tuple(layout)
+            self._iter_rows_d = self._put(np.concatenate(segments))
+            return self._iter_rows_d, self._iter_layout
         from doorman_tpu.solver.resident_mesh import (
             group_by_shard,
             pad_shard_indices,
@@ -297,16 +359,33 @@ class ResidentDenseSolver(TickEngineBase):
 
         n_dev = self._meshrows.n_dev
         Rl = self._Rp // n_dev
-        owner = fair // Rl
-        counts, (loc,) = group_by_shard(owner, n_dev, [fair - owner * Rl])
-        Fb = ceil_to(int(counts.max()) if len(fair) else 1, 8)
-        blocks = pad_shard_indices(counts, Fb, loc)
-        self._fair_rows_d = self._put_rows(blocks.astype(np.int32))
-        return self._fair_rows_d
+        blocks = []
+        layout = []
+        off = 0
+        for k in present:
+            rows = np.nonzero(kind_h[: self._R] == int(k))[0].astype(
+                np.int64
+            )
+            owner = rows // Rl
+            counts, (loc,) = group_by_shard(
+                owner, n_dev, [rows - owner * Rl]
+            )
+            Lb = ceil_to(int(counts.max()) if len(rows) else 1, 8)
+            blocks.append(
+                pad_shard_indices(counts, Lb, loc).astype(np.int32)
+            )
+            layout.append((int(k), off, Lb))
+            off += Lb
+        self._iter_layout = tuple(layout)
+        self._iter_rows_d = self._put_rows(
+            np.concatenate(blocks, axis=1)
+        )
+        return self._iter_rows_d, self._iter_layout
 
     # -- the tick executable ------------------------------------------
 
-    def _tick_fn_mesh(self, Da: int, Df: int, Sb: int, lanes: frozenset):
+    def _tick_fn_mesh(self, Da: int, Df: int, Sb: int, lanes: frozenset,
+                      ilayout: tuple = ()):
         """The shard_mapped tick: tables row-sharded over the mesh,
         staged blocks pre-partitioned per shard (leading device axis),
         no collectives (rows are independent). Scatter indices are
@@ -314,7 +393,7 @@ class ResidentDenseSolver(TickEngineBase):
         Rl and drop, padded gather slots repeat a valid index and are
         sliced off at collect."""
         track = self._track_deltas
-        key = (Da, Df, Sb, self._kfill, lanes, track)
+        key = ("mesh", Da, Df, Sb, self._kfill, lanes, track, ilayout)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -340,7 +419,7 @@ class ResidentDenseSolver(TickEngineBase):
         dtype = self._dtype
         out_dtype = self._out_dtype
         axes = self._meshrows.axes
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(ilayout, lanes)
 
         def _core(wants, has, sub, act, idx, a_w, f_block, f_act, fair,
                   cap, kind, learn, statc):
@@ -367,8 +446,7 @@ class ResidentDenseSolver(TickEngineBase):
                 gets = solve_dense_pallas(batch)
             else:
                 gets = solve_dense(
-                    batch, lanes=lanes,
-                    fair_rows=fair[0] if want_fair else None,
+                    batch, lanes=lanes, lane_rows=lane_rows_of(fair[0]),
                 )
             out = jnp.take(
                 gets, sel_idx, axis=0, mode="clip",
@@ -384,7 +462,7 @@ class ResidentDenseSolver(TickEngineBase):
             dev2,  # a_w [n_dev, Da, kfill]
             P(axes, None, None, None),  # f_block [n_dev, 2, Df, kfill]
             dev2,  # f_act [n_dev, Df, kfill]
-            rowk,  # fair rows [n_dev, Fb] (shard-local)
+            rowk,  # iter-lane rows [n_dev, ΣLb] (shard-local)
             row, row, row, row,  # per-row config
         )
 
@@ -442,9 +520,10 @@ class ResidentDenseSolver(TickEngineBase):
         self._tick_fns[key] = tick
         return tick
 
-    def _tick_fn(self, Da: int, Df: int, Sb: int, lanes: frozenset):
+    def _tick_fn(self, Da: int, Df: int, Sb: int, lanes: frozenset,
+                 ilayout: tuple = ()):
         track = self._track_deltas
-        key = (Da, Df, Sb, self._kfill, lanes, track)
+        key = (Da, Df, Sb, self._kfill, lanes, track, ilayout)
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -465,7 +544,7 @@ class ResidentDenseSolver(TickEngineBase):
         kfill = self._kfill
         dtype = self._dtype
         out_dtype = self._out_dtype
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(ilayout, lanes)
 
         # Scatters touch only the first `kfill` lanes: the table is
         # zeroed beyond every row's count at rebuild and `kfill` never
@@ -494,8 +573,7 @@ class ResidentDenseSolver(TickEngineBase):
                 gets = solve_dense_pallas(batch)
             else:
                 gets = solve_dense(
-                    batch, lanes=lanes,
-                    fair_rows=fair if want_fair else None,
+                    batch, lanes=lanes, lane_rows=lane_rows_of(fair),
                 )
             # `gets` IS the next tick's has: grants chain on device
             # (learning rows replay has, so the chain preserves them;
@@ -532,7 +610,7 @@ class ResidentDenseSolver(TickEngineBase):
         return tick
 
     def _tick_fn_fused(self, Da: int, Df: int, Sb: int, lanes: frozenset,
-                       use_bf16: bool):
+                       use_bf16: bool, ilayout: tuple = ()):
         """The one-launch fused tick: the staged blocks arrive as ONE
         uint8 buffer (packed host-side in `_launch`), bitcast apart
         in-program at static offsets, scattered, solved, delta-compared
@@ -546,7 +624,12 @@ class ResidentDenseSolver(TickEngineBase):
         kernel (pallas_dense.fused_tick_pallas): one VMEM pass per row
         tile instead of XLA re-reading gets/prev from HBM."""
         track = self._track_deltas
-        key = ("fused", Da, Df, Sb, self._kfill, lanes, track, use_bf16)
+        # The bf16 flag stays LAST in the narrow fused keys (pinned by
+        # tests/test_fused_tick.py's both-encodings check).
+        key = (
+            "fused", Da, Df, Sb, self._kfill, lanes, track, ilayout,
+            use_bf16,
+        )
         fn = self._tick_fns.get(key)
         if fn is not None:
             return fn
@@ -583,7 +666,7 @@ class ResidentDenseSolver(TickEngineBase):
         n_aw = Da * kfill * aw_item
         n_fb = 2 * Df * kfill * itemsize
         Mb = -(-Sb // kfill)  # changed-mask rows appended to the slab
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(ilayout, lanes)
 
         def unpack(buf):
             idx = jax.lax.bitcast_convert_type(
@@ -642,8 +725,7 @@ class ResidentDenseSolver(TickEngineBase):
                     changed = changed_rows[sel_idx]
                 else:
                     gets = solve_dense(
-                        batch, lanes=lanes,
-                        fair_rows=fair if want_fair else None,
+                        batch, lanes=lanes, lane_rows=lane_rows_of(fair),
                     )
                     out = gets[sel_idx, :kfill].astype(out_dtype)
                     changed = (out != prev[sel_idx, :kfill]).any(axis=1)
@@ -664,8 +746,7 @@ class ResidentDenseSolver(TickEngineBase):
                     gets = solve_dense_pallas(batch)
                 else:
                     gets = solve_dense(
-                        batch, lanes=lanes,
-                        fair_rows=fair if want_fair else None,
+                        batch, lanes=lanes, lane_rows=lane_rows_of(fair),
                     )
                 out = gets[sel_idx, :kfill].astype(out_dtype)
                 return wants, gets, sub, act, out
@@ -674,16 +755,18 @@ class ResidentDenseSolver(TickEngineBase):
         return tick
 
     def _tick_fn_fused_scoped(self, Da: int, Df: int, Sb: int, Cb: int,
-                              Fcb: int, lanes: frozenset,
+                              clayout: tuple, lanes: frozenset,
                               use_bf16: bool):
         """The scoped fused tick: staging scatters run over the full
         resident tables exactly as in `_tick_fn_fused`, then the scope
-        rows (a separate cached int32 buffer: [Cb] row indices + [Fcb]
-        compact FAIR_SHARE positions) gather into a pow2-bucketed
-        compact [Cb, K] table, ALL lanes solve over the compact table,
-        and the fresh grants scatter back into the donated resident
-        grant slab — rows outside the scope keep their resident
-        fixpoint grants untouched. Delivery gathers from the updated
+        rows (a separate cached int32 buffer: [Cb] row indices + one
+        padded segment of compact iterative-lane positions per
+        ITERATIVE_KINDS lane present, laid out by `clayout`) gather
+        into a pow2-bucketed compact [Cb, K] table, ALL lanes solve
+        over the compact table (each iterative fill restricted to its
+        own compact positions), and the fresh grants scatter back into
+        the donated resident grant slab — rows outside the scope keep
+        their resident fixpoint grants untouched. Delivery gathers from the updated
         slab, so the delivered bytes (and the delta compare against
         the prev table) are byte-identical to the full solve whenever
         the scope holds every unit not at its fixpoint — the invariant
@@ -696,7 +779,7 @@ class ResidentDenseSolver(TickEngineBase):
         identical values."""
         track = self._track_deltas
         key = (
-            "fused_scoped", Da, Df, Sb, Cb, Fcb, self._kfill, lanes,
+            "fused_scoped", Da, Df, Sb, Cb, clayout, self._kfill, lanes,
             track, use_bf16,
         )
         fn = self._tick_fns.get(key)
@@ -728,7 +811,7 @@ class ResidentDenseSolver(TickEngineBase):
         n_fb = 2 * Df * kfill * itemsize
         Mb = -(-Sb // kfill)  # changed-mask rows (tracked mode)
         Mv = -(-Cb // kfill)  # solve-moved mask rows
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(clayout, lanes)
 
         def unpack(buf):
             idx = jax.lax.bitcast_convert_type(
@@ -758,7 +841,7 @@ class ResidentDenseSolver(TickEngineBase):
             sub = sub.at[f_idx, :kfill].set(f_block[1])
             act = act.at[f_idx, :kfill].set(f_act)
             scope = scope_buf[:Cb]
-            fairpos = scope_buf[Cb:]
+            iterpos = scope_buf[Cb:]
             h_c = has[scope]
             batch = DenseBatch(
                 wants=wants[scope], has=h_c, subclients=sub[scope],
@@ -770,8 +853,7 @@ class ResidentDenseSolver(TickEngineBase):
                 gets_c = solve_dense_pallas(batch)
             else:
                 gets_c = solve_dense(
-                    batch, lanes=lanes,
-                    fair_rows=fairpos if want_fair else None,
+                    batch, lanes=lanes, lane_rows=lane_rows_of(iterpos),
                 )
             # The fixpoint test, in the solve dtype: a scope row whose
             # fresh solve equals its input has is back at rest.
@@ -821,8 +903,8 @@ class ResidentDenseSolver(TickEngineBase):
         return tick
 
     def _tick_fn_mesh_fused_scoped(self, Da: int, Df: int, Sb: int,
-                                   Cb: int, Fcb: int, lanes: frozenset,
-                                   use_bf16: bool):
+                                   Cb: int, clayout: tuple,
+                                   lanes: frozenset, use_bf16: bool):
         """Mesh variant of the scoped fused tick: each shard gathers
         its OWN scoped rows (the per-shard scoped extent: shard-local
         indices in its slice of the cached scope buffer, padded with
@@ -835,7 +917,7 @@ class ResidentDenseSolver(TickEngineBase):
         grants and changed mask as separate per-shard streams)."""
         track = self._track_deltas
         key = (
-            "fused_mesh_scoped", Da, Df, Sb, Cb, Fcb, self._kfill,
+            "fused_mesh_scoped", Da, Df, Sb, Cb, clayout, self._kfill,
             lanes, track, use_bf16,
         )
         fn = self._tick_fns.get(key)
@@ -869,7 +951,7 @@ class ResidentDenseSolver(TickEngineBase):
         n_idx = (Da + Df + Sb) * 4
         n_aw = Da * kfill * aw_item
         n_fb = 2 * Df * kfill * itemsize
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(clayout, lanes)
 
         def unpack(buf):
             idx = jax.lax.bitcast_convert_type(
@@ -902,7 +984,7 @@ class ResidentDenseSolver(TickEngineBase):
             act = act.at[f_idx, :kfill].set(f_act, mode="drop")
             sb = scope_buf[0]
             scope = sb[:Cb]
-            fairpos = sb[Cb:]
+            iterpos = sb[Cb:]
 
             def take_rows(tbl):
                 return jnp.take(
@@ -923,8 +1005,7 @@ class ResidentDenseSolver(TickEngineBase):
                 gets_c = solve_dense_pallas(batch)
             else:
                 gets_c = solve_dense(
-                    batch, lanes=lanes,
-                    fair_rows=fairpos if want_fair else None,
+                    batch, lanes=lanes, lane_rows=lane_rows_of(iterpos),
                 )
             moved = (gets_c != h_c).any(axis=1)
             has = has.at[scope].set(gets_c, mode="drop")
@@ -939,7 +1020,7 @@ class ResidentDenseSolver(TickEngineBase):
         dev2 = P(axes, None, None)
         in_specs_tail = (
             row,  # fused uint8 buffer [n_dev, B]
-            rowk,  # scope buffer [n_dev, Cb + Fcb] (shard-local)
+            rowk,  # scope buffer [n_dev, Cb + ΣLb] (shard-local)
             row, row, row, row,  # per-row config
         )
 
@@ -1000,7 +1081,8 @@ class ResidentDenseSolver(TickEngineBase):
         return tick
 
     def _tick_fn_mesh_fused(self, Da: int, Df: int, Sb: int,
-                            lanes: frozenset, use_bf16: bool):
+                            lanes: frozenset, use_bf16: bool,
+                            ilayout: tuple = ()):
         """Mesh variant of the fused upload: each shard's staged
         blocks arrive as one [1, B] uint8 slice of the sharded buffer
         and bitcast apart in-shard; the solve/delta body is the mesh
@@ -1010,7 +1092,8 @@ class ResidentDenseSolver(TickEngineBase):
         dispatches, the download is already one stream per shard."""
         track = self._track_deltas
         key = (
-            "fused_mesh", Da, Df, Sb, self._kfill, lanes, track, use_bf16
+            "fused_mesh", Da, Df, Sb, self._kfill, lanes, track,
+            ilayout, use_bf16,
         )
         fn = self._tick_fns.get(key)
         if fn is not None:
@@ -1043,7 +1126,7 @@ class ResidentDenseSolver(TickEngineBase):
         n_idx = (Da + Df + Sb) * 4
         n_aw = Da * kfill * aw_item
         n_fb = 2 * Df * kfill * itemsize
-        want_fair = int(AlgoKind.FAIR_SHARE) in lanes
+        lane_rows_of = _lane_rows_slicer(ilayout, lanes)
 
         def unpack(buf):
             idx = jax.lax.bitcast_convert_type(
@@ -1083,8 +1166,7 @@ class ResidentDenseSolver(TickEngineBase):
                 gets = solve_dense_pallas(batch)
             else:
                 gets = solve_dense(
-                    batch, lanes=lanes,
-                    fair_rows=fair[0] if want_fair else None,
+                    batch, lanes=lanes, lane_rows=lane_rows_of(fair[0]),
                 )
             out = jnp.take(
                 gets, sel_idx, axis=0, mode="clip",
@@ -1097,7 +1179,7 @@ class ResidentDenseSolver(TickEngineBase):
         dev2 = P(axes, None, None)
         in_specs_tail = (
             row,  # fused uint8 buffer [n_dev, B]
-            rowk,  # fair rows [n_dev, Fb] (shard-local)
+            rowk,  # iter-lane rows [n_dev, ΣLb] (shard-local)
             row, row, row, row,  # per-row config
         )
 
@@ -1379,7 +1461,7 @@ class ResidentDenseSolver(TickEngineBase):
         sel_pad = np.resize(sel, Sb)
         idx_host = np.concatenate([a_idx, f_idx, sel_pad]).astype(np.int32)
         lanes = self._config.lanes()
-        fair_d = self._fair_rows()
+        iter_d, ilayout = self._iter_rows()
         cfg = self._config
         from doorman_tpu.utils.transfer import start_download
 
@@ -1400,28 +1482,29 @@ class ResidentDenseSolver(TickEngineBase):
             if scope is not None:
                 # Scoped staging: the compact gather set (pow2 bucket,
                 # clamped at the padded table — a 100%-churn scope
-                # must never gather MORE than the full table) plus the
-                # FAIR_SHARE positions WITHIN the compact table, one
-                # cached int32 buffer. Padding slots repeat the
-                # reserved padding row.
+                # must never gather MORE than the full table) plus one
+                # padded segment of each iterative lane's positions
+                # WITHIN the compact table, one cached int32 buffer.
+                # Padding slots repeat the reserved padding row.
                 Cb = min(pow2_bucket(len(scope), 8), self._Rp)
-                fairpos = np.nonzero(
-                    self._config.kind_h[scope]
-                    == int(AlgoKind.FAIR_SHARE)
-                )[0]
-                Fcb = pow2_bucket(max(len(fairpos), 1), 8)
-                scope_host = np.full(Cb + Fcb, 0, np.int32)
+                kind_c = self._config.kind_h[scope]
+                clayout, pos_segments = _compact_iter_positions(
+                    kind_c, lanes
+                )
+                scope_host = np.full(
+                    Cb + sum(e[2] for e in clayout), 0, np.int32
+                )
                 scope_host[:Cb] = self._R
                 scope_host[: len(scope)] = scope
-                if len(fairpos):
-                    scope_host[Cb:] = np.resize(fairpos, Fcb)
+                if pos_segments is not None:
+                    scope_host[Cb:] = pos_segments
             ph.lap("staging")
             mask_rows = 0
             moved_rows = 0
             changed_d = None
             if scope is not None:
                 tick = self._tick_fn_fused_scoped(
-                    Da, Df, Sb, Cb, Fcb, lanes, use_bf16
+                    Da, Df, Sb, Cb, clayout, lanes, use_bf16
                 )
                 buf_d = self._put(buf)
                 scope_d = self._place_scope(scope_host, self._put)
@@ -1446,7 +1529,9 @@ class ResidentDenseSolver(TickEngineBase):
                         cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                     )
             else:
-                tick = self._tick_fn_fused(Da, Df, Sb, lanes, use_bf16)
+                tick = self._tick_fn_fused(
+                    Da, Df, Sb, lanes, use_bf16, ilayout
+                )
                 buf_d = self._put(buf)
                 if self._track_deltas:
                     (
@@ -1454,7 +1539,7 @@ class ResidentDenseSolver(TickEngineBase):
                         self._prev, out
                     ) = tick(
                         self._wants, self._has, self._sub, self._act,
-                        self._prev, buf_d, fair_d,
+                        self._prev, buf_d, iter_d,
                         cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                     )
                     mask_rows = -(-Sb // kfill)
@@ -1464,7 +1549,7 @@ class ResidentDenseSolver(TickEngineBase):
                         out
                     ) = tick(
                         self._wants, self._has, self._sub, self._act,
-                        buf_d, fair_d,
+                        buf_d, iter_d,
                         cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                     )
             count_launch()
@@ -1496,7 +1581,7 @@ class ResidentDenseSolver(TickEngineBase):
 
         ph.lap("staging")
         put = self._put
-        tick = self._tick_fn(Da, Df, Sb, lanes)
+        tick = self._tick_fn(Da, Df, Sb, lanes, ilayout)
         staged = (put(idx_host), put(a_w), put(f_block), put(f_act))
         ph.lap("upload")
         idx_d, a_w_d, f_block_d, f_act_d = staged
@@ -1507,7 +1592,7 @@ class ResidentDenseSolver(TickEngineBase):
                 self._prev, out, changed_d
             ) = tick(
                 self._wants, self._has, self._sub, self._act, self._prev,
-                idx_d, a_w_d, f_block_d, f_act_d, fair_d,
+                idx_d, a_w_d, f_block_d, f_act_d, iter_d,
                 cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
             )
         else:
@@ -1515,7 +1600,7 @@ class ResidentDenseSolver(TickEngineBase):
                 self._wants, self._has, self._sub, self._act, out
             ) = tick(
                 self._wants, self._has, self._sub, self._act,
-                idx_d, a_w_d, f_block_d, f_act_d, fair_d,
+                idx_d, a_w_d, f_block_d, f_act_d, iter_d,
                 cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
             )
         count_launch()
@@ -1613,14 +1698,17 @@ class ResidentDenseSolver(TickEngineBase):
             [a_idx_b, f_idx_b, sel_b], axis=1
         ).astype(np.int32)
         lanes = self._config.lanes()
-        fair_d = self._fair_rows()
+        iter_d, ilayout = self._iter_rows()
         fused = self._fused
         counts_c = None
         if scope is not None:
             # Per-shard scoped extents: the global (sorted) scope
             # groups into contiguous shard-local blocks; pads carry the
             # out-of-range index Rl (gather-clip / scatter-drop). The
-            # compact FAIR_SHARE positions are per shard too.
+            # compact iterative-lane positions are per shard too: each
+            # ITERATIVE_KINDS lane present gets one padded segment of
+            # positions within the shard's compact block, all shards
+            # sharing one static layout (max bucket across shards).
             owner_c = scope // Rl
             counts_c, (scope_loc,) = group_by_shard(
                 owner_c, n_dev, [scope - owner_c * Rl]
@@ -1632,28 +1720,35 @@ class ResidentDenseSolver(TickEngineBase):
                 Rl,
             )
             scope_blocks = np.full((n_dev, Cb), Rl, np.int32)
-            fair_counts = np.zeros(n_dev, np.int64)
-            fair_locs = []
-            pos = 0
             kind_h = self._config.kind_h
+            iter_kinds = sorted(ITERATIVE_KINDS & set(lanes))
+            pos_locs = {k: [] for k in iter_kinds}
+            pos = 0
             for d in range(n_dev):
                 c = int(counts_c[d])
                 scope_blocks[d, :c] = scope_loc[pos : pos + c]
-                fp = np.nonzero(
-                    kind_h[scope[pos : pos + c]]
-                    == int(AlgoKind.FAIR_SHARE)
-                )[0]
-                fair_counts[d] = len(fp)
-                fair_locs.append(fp)
+                kind_c = kind_h[scope[pos : pos + c]]
+                for k in iter_kinds:
+                    pos_locs[k].append(
+                        np.nonzero(kind_c == int(k))[0]
+                    )
                 pos += c
-            Fcb = pow2_bucket(max(int(fair_counts.max()), 1), 8)
-            fair_blocks = np.zeros((n_dev, Fcb), np.int32)
-            for d, fp in enumerate(fair_locs):
-                if len(fp):
-                    fair_blocks[d] = np.resize(fp, Fcb)
-            scope_host = np.concatenate(
-                [scope_blocks, fair_blocks], axis=1
-            )
+            clayout = []
+            blocks = [scope_blocks]
+            off = 0
+            for k in iter_kinds:
+                Lb = pow2_bucket(
+                    max(max(len(p) for p in pos_locs[k]), 1), 8
+                )
+                blk = np.zeros((n_dev, Lb), np.int32)
+                for d, p in enumerate(pos_locs[k]):
+                    if len(p):
+                        blk[d] = np.resize(p, Lb)
+                blocks.append(blk)
+                clayout.append((int(k), off, Lb))
+                off += Lb
+            clayout = tuple(clayout)
+            scope_host = np.concatenate(blocks, axis=1)
         if fused:
             # Fused upload: one [n_dev, B] uint8 buffer whose per-shard
             # slice carries that shard's staged blocks back to back
@@ -1697,7 +1792,7 @@ class ResidentDenseSolver(TickEngineBase):
             buf_d = put(buf_host)
             if scope is not None:
                 tick = self._tick_fn_mesh_fused_scoped(
-                    Da, Df, Sb, Cb, Fcb, lanes, use_bf16
+                    Da, Df, Sb, Cb, clayout, lanes, use_bf16
                 )
                 scope_d = self._place_scope(scope_host, put)
                 if self._track_deltas:
@@ -1720,32 +1815,32 @@ class ResidentDenseSolver(TickEngineBase):
                     )
             elif self._track_deltas:
                 tick = self._tick_fn_mesh_fused(
-                    Da, Df, Sb, lanes, use_bf16
+                    Da, Df, Sb, lanes, use_bf16, ilayout
                 )
                 (
                     self._wants, self._has, self._sub, self._act,
                     self._prev, out, changed_d
                 ) = tick(
                     self._wants, self._has, self._sub, self._act,
-                    self._prev, buf_d, fair_d,
+                    self._prev, buf_d, iter_d,
                     cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                 )
             else:
                 tick = self._tick_fn_mesh_fused(
-                    Da, Df, Sb, lanes, use_bf16
+                    Da, Df, Sb, lanes, use_bf16, ilayout
                 )
                 (
                     self._wants, self._has, self._sub, self._act, out
                 ) = tick(
                     self._wants, self._has, self._sub, self._act,
-                    buf_d, fair_d,
+                    buf_d, iter_d,
                     cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                 )
             count_launch()
             out = start_sharded_download(out)
             ph.lap("fused")
         else:
-            tick = self._tick_fn_mesh(Da, Df, Sb, lanes)
+            tick = self._tick_fn_mesh(Da, Df, Sb, lanes, ilayout)
             staged = (put(idx_host), put(a_w_b), put(f_block), put(f_a_b))
             ph.lap("upload")
             idx_d, a_w_d, f_block_d, f_a_d = staged
@@ -1756,7 +1851,7 @@ class ResidentDenseSolver(TickEngineBase):
                 ) = tick(
                     self._wants, self._has, self._sub, self._act,
                     self._prev,
-                    idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                    idx_d, a_w_d, f_block_d, f_a_d, iter_d,
                     cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                 )
             else:
@@ -1764,7 +1859,7 @@ class ResidentDenseSolver(TickEngineBase):
                     self._wants, self._has, self._sub, self._act, out
                 ) = tick(
                     self._wants, self._has, self._sub, self._act,
-                    idx_d, a_w_d, f_block_d, f_a_d, fair_d,
+                    idx_d, a_w_d, f_block_d, f_a_d, iter_d,
                     cfg.cap_d, cfg.kind_d, cfg.learn_d, cfg.statc_d,
                 )
             count_launch()

@@ -116,6 +116,41 @@ def test_master_flap_warm_restores_instead_of_relearning(verdicts):
     assert warm[0] < v["heal_tick"]
 
 
+WARM_VARIANTS = sorted(n for n in PLANS if n.startswith("master_flap_warm_"))
+
+
+@pytest.mark.parametrize("name", WARM_VARIANTS)
+def test_master_flap_warm_arc_per_fairness_lane(verdicts, name):
+    """The warm-takeover contract is algorithm-independent: every
+    fairness-portfolio lane (fair/maxmin/balanced/logutil) restores
+    instead of relearning, skips learning on the clean step-down, and
+    reconverges inside the SAME budget the proportional plan meets."""
+    v = verdicts[name]
+    plan = get_plan(name)
+    restores = [e for e in v["event_log"] if e[1] == "restore"]
+    assert [e[3] for e in restores] == ["cold_empty", "warm"]
+    warm = restores[-1]
+    server, _mode, leases, clean_down, learning = warm[2:]
+    assert server == "s1" and leases == len(plan.setup["wants"])
+    assert clean_down is True
+    assert learning == [["r0", "skip"]]
+    assert v["converged_after_heal_ticks"] <= plan.reconverge_ticks
+    assert warm[0] < v["heal_tick"]
+
+
+def test_master_flap_warm_variant_logs_deterministic(verdicts):
+    """One representative portfolio parametrization replayed from
+    scratch produces the module fixture's event log byte-for-byte —
+    the per-kind determinism pin (the seeded-replay contract extends
+    to the new lanes' solve paths)."""
+    name = "master_flap_warm_maxmin"
+    again = run_plan(name)
+    assert again["event_log"] == verdicts[name]["event_log"]
+    assert again["converged_after_heal_ticks"] == (
+        verdicts[name]["converged_after_heal_ticks"]
+    )
+
+
 def test_client_storm_sheds_bottom_up_with_top_band_floor(verdicts):
     v = verdicts["client_storm"]
     plan = get_plan("client_storm")

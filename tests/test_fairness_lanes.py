@@ -1,0 +1,518 @@
+"""The device-resident fairness portfolio: MAX_MIN_FAIR,
+BALANCED_FAIRNESS and PROPORTIONAL_FAIRNESS as compiled-away lanes of
+the fused scoped tick.
+
+Pins the acceptance surface of the portfolio (ISSUE 15):
+
+  * host-reference parity: every lane's tick output is pinned to its
+    numpy oracle (algorithms.tick) — exact for the pointwise steps,
+    <= 1-ulp-scale for the bounded iterative fills (the FAIR_SHARE
+    precedent) — through the BatchSolver AND through the scoped/fused
+    resident tick on all four resident paths (narrow/wide x
+    single-device/mesh; the mesh legs need the forced 8-device CPU of
+    the multichip CI job);
+  * scoped/fused byte identity: scoped-vs-full stores are IDENTICAL
+    over seeded churn for a mixed ALL-lane resource table, per path;
+  * compile-away: a lane absent from the static kind set leaves NO
+    trace in the solve executable (jaxpr pin: the proportional-only
+    solve lowers without a single `while` — every iterative fill is
+    gone) and the per-tick dispatch/launch count is identical across
+    lane choices (the launch-structure pin behind the bench's
+    compile-away row);
+  * config-epoch handling: flipping a template's `variant` parameter
+    re-maps the lane through algo_kind_for and the next tick solves
+    with the new lane's math;
+  * federation: each lane's compact summary reconciles into per-shard
+    shares whose local (per-shard) solve recovers the global
+    allocation.
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu import native
+from doorman_tpu.algorithms import tick as tick_oracles
+from doorman_tpu.algorithms.kinds import AlgoKind
+from doorman_tpu.core.resource import Resource, algo_kind_for
+from doorman_tpu.parallel import make_mesh
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.solver.resident import ResidentDenseSolver
+from doorman_tpu.solver.resident_wide import WideResidentSolver
+from doorman_tpu.utils import dispatch as dispatch_mod
+from tests.test_engine import assert_store_parity, conformance_churn
+from tests.test_resident_solver import all_leases
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native engine unavailable"
+)
+
+PATHS = ("resident", "resident_mesh", "wide", "wide_mesh")
+
+# (wire kind, variant) per lane — the whole portfolio plus the
+# reference lanes it must coexist with in one mixed table.
+LANE_TEMPLATES = [
+    (pb.Algorithm.PROPORTIONAL_SHARE, None),
+    (pb.Algorithm.FAIR_SHARE, None),
+    (pb.Algorithm.FAIR_SHARE, "maxmin"),
+    (pb.Algorithm.FAIR_SHARE, "balanced"),
+    (pb.Algorithm.PROPORTIONAL_SHARE, "logutil"),
+    (pb.Algorithm.NO_ALGORITHM, None),
+    (pb.Algorithm.STATIC, None),
+]
+
+NEW_LANES = (
+    AlgoKind.MAX_MIN_FAIR,
+    AlgoKind.BALANCED_FAIRNESS,
+    AlgoKind.PROPORTIONAL_FAIRNESS,
+)
+
+
+def _template(r, wire_kind, variant, capacity):
+    algo = pb.Algorithm(
+        kind=int(wire_kind), lease_length=60, refresh_interval=5
+    )
+    if variant is not None:
+        algo.parameters.add(name="variant", value=variant)
+    return pb.ResourceTemplate(
+        identifier_glob=f"res{r}", capacity=capacity, algorithm=algo
+    )
+
+
+def make_portfolio_world(clock, n_res=14, n_clients=9, seed=7):
+    """One engine + resources cycling through EVERY lane, with varied
+    subclients (so the subclient-weighted lanes genuinely diverge from
+    the client-granular one) and integer demand (exactly-representable
+    inputs: the repo's bit-parity convention)."""
+    rng = np.random.default_rng(seed)
+    engine = native.StoreEngine(clock=clock)
+    resources = []
+    for r in range(n_res):
+        wire_kind, variant = LANE_TEMPLATES[r % len(LANE_TEMPLATES)]
+        tpl = _template(
+            r, wire_kind, variant, float(rng.integers(50, 400))
+        )
+        res = Resource(
+            f"res{r}", tpl, clock=clock, store_factory=engine.store
+        )
+        resources.append(res)
+        for c in range(n_clients):
+            res.store.assign(
+                f"c{r}_{c}", 60.0, 5.0, 0.0,
+                float(rng.integers(1, 100)), int(rng.integers(1, 5)),
+            )
+    return engine, resources
+
+
+def _make(path, engine, clock, scoped=True, fused=True):
+    mesh = make_mesh() if path.endswith("_mesh") else None
+    if path.startswith("resident"):
+        return ResidentDenseSolver(
+            engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+            mesh=mesh, fused=fused, scoped=scoped,
+        )
+    return WideResidentSolver(
+        engine, dtype=np.float64, clock=clock, rotate_ticks=1,
+        chunk_width=8, mesh=mesh, fused=fused, scoped=scoped,
+    )
+
+
+def _oracle_for(res, wants, has, sub):
+    from doorman_tpu.core.resource import static_param
+
+    return tick_oracles.oracle_row(
+        algo_kind_for(res.template), res.capacity,
+        static_param(res.template), wants, has, sub,
+    )
+
+
+# ---------------------------------------------------------------------
+# host-reference parity through the full stack
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_first_tick_pinned_to_host_oracles(path):
+    """The first full-delivery tick solves every lane from (wants,
+    has=0) — its stores must match each lane's numpy oracle. Narrow
+    paths bit-identical on these exactly-representable inputs; the
+    wide paths carry their documented reassociation tolerance, and the
+    iterative fills their <= 1-ulp budget (rtol 1e-12 covers both)."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    engine, resources = make_portfolio_world(clock)
+    solver = _make(path, engine, clock)
+    solver.step(resources, 0)
+    exercised = set()
+    for res in resources:
+        names = sorted(c for c, _ in res.store.items())
+        leases = [res.store.get(c) for c in names]
+        wants = np.array([l.wants for l in leases])
+        got = np.array([l.has for l in leases])
+        sub = np.array([float(l.subclients) for l in leases])
+        expected = _oracle_for(
+            res, wants, np.zeros_like(wants), sub
+        )
+        np.testing.assert_allclose(
+            got, expected, rtol=1e-12, atol=0,
+            err_msg=f"{path} {res.id} "
+                    f"lane {AlgoKind(algo_kind_for(res.template)).name}",
+        )
+        exercised.add(algo_kind_for(res.template))
+    assert {int(k) for k in NEW_LANES} <= exercised
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_scoped_vs_full_byte_identity_all_lanes(path):
+    """Scoped vs full solves over the mixed all-lane table: stores
+    byte-identical every tick, per resident path, with the narrow
+    paths' changed-rid streams equal too (the streaming-push input)."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_portfolio_world(clock)
+    eng_b, res_b = make_portfolio_world(clock)
+    full = _make(path, eng_a, clock, scoped=False)
+    scoped = _make(path, eng_b, clock, scoped=True)
+    track = path.startswith("resident")
+    if track:
+        assert full.enable_delta_tracking()
+        assert scoped.enable_delta_tracking()
+    rng_a, rng_b = (np.random.default_rng(31) for _ in range(2))
+    scoped_ran = 0
+    for step in range(8):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        full.step(res_a, 0)
+        scoped.step(res_b, 0)
+        ref, got = all_leases(res_a), all_leases(res_b)
+        assert ref.keys() == got.keys(), f"{path} step {step}"
+        for key in ref:
+            assert got[key] == ref[key], (
+                f"{path} step {step} lease {key}: "
+                f"{got[key]} != {ref[key]}"
+            )
+        if track:
+            assert (
+                sorted(full.take_changed_rids())
+                == sorted(scoped.take_changed_rids())
+            ), f"{path} step {step}: changed-rid streams diverged"
+        if scoped.last_solve_mode == "scoped":
+            scoped_ran += 1
+        t[0] += 1.0
+    assert scoped_ran >= 4, scoped.solve_modes
+
+
+@pytest.mark.parametrize("path", ("resident", "wide"))
+def test_steady_churn_matches_batch_ground_truth(path):
+    """Scoped/fused resident ticks over the all-lane world track the
+    BatchSolver ground truth (itself pinned to the oracles) through
+    churn — membership changes, releases, both bf16 encodings."""
+    from doorman_tpu.solver.batch import BatchSolver
+    from doorman_tpu.solver.engine import BatchTickAdapter
+
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    eng_a, res_a = make_portfolio_world(clock)
+    eng_b, res_b = make_portfolio_world(clock)
+    batch = BatchTickAdapter(BatchSolver(dtype=np.float64, clock=clock))
+    solver = _make(path, eng_b, clock, scoped=True)
+    rng_a, rng_b = (np.random.default_rng(47) for _ in range(2))
+    for step in range(6):
+        conformance_churn(res_a, step, rng_a)
+        conformance_churn(res_b, step, rng_b)
+        batch.step(res_a, 0)
+        solver.step(res_b, 0)
+        assert_store_parity(
+            all_leases(res_a), all_leases(res_b), path, f"step {step}"
+        )
+        t[0] += 1.0
+
+
+def test_batch_solver_pins_every_lane_to_oracle():
+    """The BatchSolver leg of the parity ladder: one snapshot solve of
+    the portfolio world equals the per-lane oracles directly (so the
+    resident-vs-batch pins above chain back to the host references)."""
+    from doorman_tpu.solver.batch import BatchSolver
+    from doorman_tpu.solver.engine import BatchTickAdapter
+
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    engine, resources = make_portfolio_world(clock)
+    batch = BatchTickAdapter(BatchSolver(dtype=np.float64, clock=clock))
+    batch.step(resources, 0)
+    for res in resources:
+        names = sorted(c for c, _ in res.store.items())
+        leases = [res.store.get(c) for c in names]
+        wants = np.array([l.wants for l in leases])
+        got = np.array([l.has for l in leases])
+        sub = np.array([float(l.subclients) for l in leases])
+        expected = _oracle_for(res, wants, np.zeros_like(wants), sub)
+        np.testing.assert_allclose(
+            got, expected, rtol=1e-12, atol=0, err_msg=res.id
+        )
+
+
+def test_portfolio_lanes_genuinely_differ():
+    """The lanes are a portfolio, not aliases: on a table with varied
+    subclients and an overloaded pool, MAX_MIN_FAIR (client-granular)
+    diverges from FAIR_SHARE (subclient-weighted), and the truncated
+    BALANCED_FAIRNESS recursion may under-fill where the efficient
+    lanes exhaust. PROPORTIONAL_FAIRNESS's dual fixpoint agrees with
+    FAIR_SHARE's bisection at convergence (the single-capacity KKT
+    coincidence, doc/algorithms.md) — within iteration tolerance, NOT
+    necessarily bitwise."""
+    wants = np.array([80.0, 30.0, 10.0, 60.0])
+    sub = np.array([4.0, 1.0, 2.0, 1.0])
+    cap = 100.0
+    fair = tick_oracles.fair_share_waterfill(cap, wants, sub)
+    maxmin = tick_oracles.max_min_fair_tick(cap, wants)
+    pf = tick_oracles.proportional_fairness_tick(cap, wants, sub)
+    bal = tick_oracles.balanced_fairness_tick(cap, wants, sub)
+    assert not np.allclose(fair, maxmin)
+    np.testing.assert_allclose(pf, fair, rtol=1e-9)
+    assert bal.sum() <= cap + 1e-9
+    assert (bal <= wants + 1e-12).all()
+
+
+# ---------------------------------------------------------------------
+# compile-away
+# ---------------------------------------------------------------------
+
+
+def _mixed_batch(kinds):
+    import jax.numpy as jnp
+
+    from doorman_tpu.solver.dense import DenseBatch
+
+    rng = np.random.default_rng(3)
+    R, K = len(kinds), 8
+    return DenseBatch(
+        wants=jnp.asarray(rng.integers(0, 50, (R, K)).astype(float)),
+        has=jnp.asarray(rng.integers(0, 20, (R, K)).astype(float)),
+        subclients=jnp.asarray(np.ones((R, K))),
+        active=jnp.asarray(np.ones((R, K), bool)),
+        capacity=jnp.asarray(np.full(R, 60.0)),
+        algo_kind=jnp.asarray(np.asarray(kinds, np.int32)),
+        learning=jnp.asarray(np.zeros(R, bool)),
+        static_capacity=jnp.asarray(np.zeros(R)),
+    )
+
+
+def _has_loop(jaxpr_text: str) -> bool:
+    # fori_loop lowers to `scan` when the trip count is static and
+    # `while` otherwise; either marks an iterative fill.
+    return "scan" in jaxpr_text or "while" in jaxpr_text
+
+
+def test_absent_lanes_compile_away_jaxpr_pin():
+    """The masking-seam pin at the jaxpr level: with only
+    PROPORTIONAL_SHARE in the static kind set, the lowered solve
+    contains NO loop primitive (every iterative fill — FAIR_SHARE's
+    bisection and all three portfolio fills — is gone, not masked);
+    each portfolio lane added to the set brings its loop back."""
+    import jax
+
+    from doorman_tpu.solver.dense import solve_dense
+
+    prop = int(AlgoKind.PROPORTIONAL_SHARE)
+    batch = _mixed_batch([prop] * 4)
+    base = jax.make_jaxpr(
+        lambda b: solve_dense(b, lanes=frozenset({prop}))
+    )(batch)
+    assert not _has_loop(str(base)), (
+        "proportional-only solve still lowers an iterative fill"
+    )
+    for lane in NEW_LANES:
+        with_lane = jax.make_jaxpr(
+            lambda b: solve_dense(
+                b, lanes=frozenset({prop, int(lane)})
+            )
+        )(batch)
+        assert _has_loop(str(with_lane)), AlgoKind(lane).name
+        # And removing it again restores the baseline jaxpr exactly.
+        again = jax.make_jaxpr(
+            lambda b: solve_dense(b, lanes=frozenset({prop}))
+        )(batch)
+        assert str(again) == str(base)
+
+
+def test_lane_choice_never_changes_launch_structure():
+    """The launch-count pin behind the bench's compile-away row: a
+    steady fused+scoped tick costs the SAME number of device
+    dispatches whichever single lane the table runs — lanes change
+    executable content, never launch structure."""
+    counts = {}
+    for label, wire_kind, variant in (
+        ("prop", pb.Algorithm.PROPORTIONAL_SHARE, None),
+        ("maxmin", pb.Algorithm.FAIR_SHARE, "maxmin"),
+        ("balanced", pb.Algorithm.FAIR_SHARE, "balanced"),
+        ("logutil", pb.Algorithm.PROPORTIONAL_SHARE, "logutil"),
+    ):
+        t = [1000.0]
+        clock = lambda: t[0]  # noqa: E731
+        rng = np.random.default_rng(5)
+        engine = native.StoreEngine(clock=clock)
+        resources = []
+        for r in range(8):
+            tpl = _template(r, wire_kind, variant, 100.0)
+            res = Resource(
+                f"res{r}", tpl, clock=clock, store_factory=engine.store
+            )
+            resources.append(res)
+            for c in range(6):
+                res.store.assign(
+                    f"c{r}_{c}", 60.0, 5.0, 0.0,
+                    float(rng.integers(1, 60)), 1,
+                )
+        solver = _make("resident", engine, clock, scoped=True)
+        solver.step(resources, 0)  # rebuild + compile
+        per_tick = []
+        for step in range(3):
+            resources[step % 8].store.assign(
+                f"c{step % 8}_0", 60.0, 5.0,
+                resources[step % 8].store.get(f"c{step % 8}_0").has,
+                float(rng.integers(1, 60)), 1,
+            )
+            mark = dispatch_mod.snapshot()
+            solver.step(resources, 0)
+            per_tick.append(dispatch_mod.delta(mark)["dispatches"])
+            t[0] += 1.0
+        counts[label] = per_tick
+    assert len({tuple(v) for v in counts.values()}) == 1, counts
+
+
+# ---------------------------------------------------------------------
+# config-epoch handling
+# ---------------------------------------------------------------------
+
+
+def test_variant_flip_remaps_lane_on_config_epoch():
+    """A config reload that only flips the `variant` parameter re-maps
+    the device lane (algo_kind_for feeds the solver's config mirror):
+    the next tick solves with the NEW lane's math — pinned by oracle
+    comparison on both sides of the flip."""
+    t = [1000.0]
+    clock = lambda: t[0]  # noqa: E731
+    rng = np.random.default_rng(13)
+    engine = native.StoreEngine(clock=clock)
+    tpl = _template(0, pb.Algorithm.FAIR_SHARE, None, 100.0)
+    res = Resource("res0", tpl, clock=clock, store_factory=engine.store)
+    for c in range(7):
+        res.store.assign(
+            f"c{c}", 60.0, 5.0, 0.0,
+            float(rng.integers(20, 90)), int(rng.integers(1, 5)),
+        )
+    solver = _make("resident", engine, clock, scoped=True)
+    solver.step([res], 0)
+    names = sorted(c for c, _ in res.store.items())
+    wants = np.array([res.store.get(c).wants for c in names])
+    sub = np.array([float(res.store.get(c).subclients) for c in names])
+    got = np.array([res.store.get(c).has for c in names])
+    np.testing.assert_allclose(
+        got, tick_oracles.fair_share_waterfill(100.0, wants, sub),
+        rtol=1e-12,
+    )
+    # The reload: same wire kind, new variant.
+    res.load_config(
+        _template(0, pb.Algorithm.FAIR_SHARE, "maxmin", 100.0), None
+    )
+    assert algo_kind_for(res.template) == int(AlgoKind.MAX_MIN_FAIR)
+    res.store.assign(
+        names[0], 60.0, 5.0, res.store.get(names[0]).has,
+        float(wants[0]), int(sub[0]),
+    )
+    solver.step([res], 1)  # epoch bump: mirror re-reads the kind vector
+    assert solver.last_full_reason == "config-epoch"
+    got = np.array([res.store.get(c).has for c in names])
+    np.testing.assert_allclose(
+        got, tick_oracles.max_min_fair_tick(100.0, wants), rtol=1e-12
+    )
+
+
+# ---------------------------------------------------------------------
+# federation share derivation
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "lane", [int(k) for k in NEW_LANES], ids=[k.name for k in NEW_LANES]
+)
+def test_sharded_shares_recover_global_allocation(lane):
+    """Each new lane's compact summary reconciles into per-shard
+    shares whose LOCAL solve (the lane's own tick oracle over only the
+    shard's clients at its share) reproduces the GLOBAL allocation —
+    the POP decomposition extended to the portfolio."""
+    from doorman_tpu.federation.reconcile import (
+        ShardSummary,
+        StraddleReconciler,
+        _UNWEIGHTED_KINDS,
+    )
+
+    rng = np.random.default_rng(lane)
+    cap = 300.0
+    shards = {0: [], 1: [], 2: []}
+    for i in range(18):
+        shards[i % 3].append(
+            (float(rng.integers(10, 80)), float(rng.integers(1, 5)))
+        )
+
+    def solve(kind, capacity, wants, sub):
+        if kind == int(AlgoKind.MAX_MIN_FAIR):
+            return tick_oracles.max_min_fair_tick(capacity, wants)
+        if kind == int(AlgoKind.BALANCED_FAIRNESS):
+            return tick_oracles.balanced_fairness_tick(
+                capacity, wants, sub
+            )
+        return tick_oracles.proportional_fairness_tick(
+            capacity, wants, sub
+        )
+
+    all_wants = np.array([w for cl in shards.values() for (w, _s) in cl])
+    all_sub = np.array([s for cl in shards.values() for (_w, s) in cl])
+    global_gets = solve(lane, cap, all_wants, all_sub)
+    assert all_wants.sum() > cap  # overloaded, or the split is trivial
+
+    def summary(shard, clients):
+        by_ratio = {}
+        wants_sum = weight_sum = 0.0
+        for w, s in clients:
+            weight = 1.0 if lane in _UNWEIGHTED_KINDS else s
+            acc = by_ratio.setdefault(w / weight, [0.0, 0.0])
+            acc[0] += w
+            acc[1] += weight
+            wants_sum += w
+            weight_sum += weight
+        return ShardSummary(
+            shard=shard, wants=wants_sum, weight=weight_sum,
+            breakpoints=tuple(
+                (r, by_ratio[r][0], by_ratio[r][1])
+                for r in sorted(by_ratio)
+            ),
+        )
+
+    rec = StraddleReconciler(
+        "r0", cap, lane, share_ttl=10.0, lease_length=5.0
+    )
+    shares = rec.reconcile(
+        {s: summary(s, cl) for s, cl in shards.items()}, now=0.0
+    )
+    assert sum(shares.values()) <= cap * (1 + 1e-12)
+    pos = 0
+    for s, clients in shards.items():
+        wants = np.array([w for (w, _s) in clients])
+        sub = np.array([x for (_w, x) in clients])
+        local = solve(lane, shares[s], wants, sub)
+        np.testing.assert_allclose(
+            local, global_gets[pos : pos + len(clients)],
+            rtol=1e-9, atol=1e-9,
+            err_msg=f"shard {s} local solve diverged from global",
+        )
+        pos += len(clients)
+
+
+def test_reconciler_accepts_portfolio_kinds():
+    from doorman_tpu.federation.reconcile import CAPACITY_SPLIT_KINDS
+
+    for lane in NEW_LANES:
+        assert int(lane) in CAPACITY_SPLIT_KINDS

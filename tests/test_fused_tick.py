@@ -296,6 +296,12 @@ def test_fused_pallas_kernel_all_lanes_parity():
                 AlgoKind.PROPORTIONAL_SHARE,
                 AlgoKind.FAIR_SHARE,
                 AlgoKind.PROPORTIONAL_TOPUP,
+                # The fairness portfolio rides the same kernel body:
+                # its bounded fills must hold the same fused-vs-unfused
+                # bit identity and kernel-vs-XLA tolerance.
+                AlgoKind.MAX_MIN_FAIR,
+                AlgoKind.BALANCED_FAIRNESS,
+                AlgoKind.PROPORTIONAL_FAIRNESS,
             )
         ],
         R,

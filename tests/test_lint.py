@@ -38,6 +38,9 @@ import enum
 class AlgoKind(enum.IntEnum):
     NO_ALGORITHM = 0
     FAIR_SHARE = 3
+    MAX_MIN_FAIR = 7
+    BALANCED_FAIRNESS = 8
+    PROPORTIONAL_FAIRNESS = 9
 """
 
 ENGINE_REGISTRY = """
@@ -116,6 +119,34 @@ def test_jit_capture_flags_pr4_enum_closure(tree):
 def test_jit_capture_int_wrap_is_clean(tree):
     tree.write("doorman_tpu/solver/pallas_dense.py", PR4_GOOD)
     assert tree.active(rules=["jit-closure-capture"]) == []
+
+
+def test_jit_capture_flags_new_portfolio_members(tree):
+    """The fairness-portfolio AlgoKind members are exactly the PR-4
+    jit-closure-capture bug class: a NEW member used bare in device
+    code must be flagged by the mined-registry rule (real lanes wrap
+    with int()) — the registry is mined from the tree's IntEnum
+    classes, so newly added members are covered without touching the
+    linter."""
+    tree.write("doorman_tpu/solver/pallas_dense.py", """
+import jax.numpy as jnp
+
+from doorman_tpu.algorithms.kinds import AlgoKind
+
+
+def _kernel(kind_ref, wants_ref, out_ref):
+    gets = jnp.zeros_like(wants_ref[:])
+    gets = jnp.where(
+        kind_ref[:] == AlgoKind.MAX_MIN_FAIR, wants_ref[:], gets
+    )
+    gets = jnp.where(
+        kind_ref[:] == int(AlgoKind.BALANCED_FAIRNESS), wants_ref[:], gets
+    )
+    out_ref[:] = gets
+""")
+    found = tree.active(rules=["jit-closure-capture"])
+    assert len(found) == 1
+    assert "AlgoKind.MAX_MIN_FAIR" in found[0].message
 
 
 def test_jit_capture_covers_jitted_functions(tree):

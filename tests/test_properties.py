@@ -29,6 +29,13 @@ FEASIBLE_KINDS = (
     AlgoKind.PROPORTIONAL_SHARE,
     AlgoKind.FAIR_SHARE,
     AlgoKind.PROPORTIONAL_TOPUP,
+    # The fairness portfolio: feasibility holds at ANY truncation of
+    # their bounded fills (the level is monotone from below /
+    # cap-peeling only ever un-claims), so these ride the general
+    # invariants at full table sizes.
+    AlgoKind.MAX_MIN_FAIR,
+    AlgoKind.BALANCED_FAIRNESS,
+    AlgoKind.PROPORTIONAL_FAIRNESS,
 )
 
 
@@ -114,6 +121,88 @@ def test_fair_share_floor(table):
     equal = capacity / sub_arr.sum() * sub_arr
     demanding = wants_arr >= equal
     assert (gets[demanding] >= equal[demanding] * (1 - 1e-9) - 1e-6).all()
+
+
+@settings(max_examples=40, deadline=None)
+@given(demand_tables(max_clients=12))
+def test_max_min_dominance(table):
+    """MAX_MIN_FAIR is max-min fair at the client grain: in overload
+    every unsatisfied client receives the common water level, and every
+    satisfied client wants no more than it (so no grant can grow
+    without shrinking a smaller one). max_clients=12 < FILL_ITERS keeps
+    the bounded fill exactly converged (each non-final iteration
+    saturates at least one client)."""
+    wants, has, sub, capacity = table
+    wants_arr = np.asarray(wants, np.float64)
+    if wants_arr.sum() <= capacity:
+        return  # underloaded: gets == wants, trivially max-min
+    gets = np.asarray(
+        solve_dense(
+            dense_batch(wants, has, sub, capacity, AlgoKind.MAX_MIN_FAIR)
+        )
+    )[0][: len(wants)]
+    unsat = gets < wants_arr * (1 - 1e-12) - 1e-12
+    if unsat.any():
+        level = gets[unsat].max()
+        np.testing.assert_allclose(gets[unsat], level, rtol=1e-9)
+        assert (wants_arr[~unsat] <= level * (1 + 1e-9) + 1e-6).all()
+    # Subclient weights must NOT skew the fill (that is FAIR_SHARE):
+    ones = [1] * len(wants)
+    gets_unw = np.asarray(
+        solve_dense(
+            dense_batch(wants, has, ones, capacity, AlgoKind.MAX_MIN_FAIR)
+        )
+    )[0][: len(wants)]
+    np.testing.assert_array_equal(gets, gets_unw)
+
+
+@settings(max_examples=40, deadline=None)
+@given(demand_tables(max_clients=12))
+def test_proportional_fairness_pareto_and_oracle(table):
+    """PROPORTIONAL_FAIRNESS is Pareto-efficient at convergence (the
+    dual fixpoint exhausts min(capacity, Σwants) — no grant can grow
+    without shrinking another) and matches its host reference."""
+    from doorman_tpu.algorithms.tick import proportional_fairness_tick
+
+    wants, has, sub, capacity = table
+    wants_arr = np.asarray(wants, np.float64)
+    gets = np.asarray(
+        solve_dense(
+            dense_batch(
+                wants, has, sub, capacity, AlgoKind.PROPORTIONAL_FAIRNESS
+            )
+        )
+    )[0][: len(wants)]
+    ref = proportional_fairness_tick(
+        capacity, wants_arr, np.asarray(sub, np.float64)
+    )
+    np.testing.assert_allclose(gets, ref, rtol=1e-9, atol=1e-9)
+    target = min(capacity, float(wants_arr.sum()))
+    assert gets.sum() >= target * (1 - 1e-9) - 1e-6  # Pareto: exhausted
+    assert gets.sum() <= target * (1 + 1e-9) + 1e-6
+
+
+@settings(max_examples=40, deadline=None)
+@given(demand_tables(max_clients=12))
+def test_balanced_fairness_oracle_and_feasible_slack(table):
+    """BALANCED_FAIRNESS matches its host reference; unlike the
+    efficient lanes it MAY leave capacity unclaimed (the insensitivity
+    truncation), so only feasibility — not exhaustion — is pinned."""
+    from doorman_tpu.algorithms.tick import balanced_fairness_tick
+
+    wants, has, sub, capacity = table
+    gets = np.asarray(
+        solve_dense(
+            dense_batch(
+                wants, has, sub, capacity, AlgoKind.BALANCED_FAIRNESS
+            )
+        )
+    )[0][: len(wants)]
+    ref = balanced_fairness_tick(
+        capacity, np.asarray(wants, np.float64),
+        np.asarray(sub, np.float64),
+    )
+    np.testing.assert_allclose(gets, ref, rtol=1e-9, atol=1e-9)
 
 
 @settings(max_examples=25, deadline=None)

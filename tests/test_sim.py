@@ -64,6 +64,21 @@ class TestScenarios:
         assert s["utilization"] > 0.85
         assert s["overage_events"] == 0
 
+    @pytest.mark.parametrize(
+        "name", ["1_fair", "1_maxmin", "1_balanced", "1_logutil"]
+    )
+    def test_scenario_one_converges_per_fairness_lane(self, name):
+        """The scenario-one convergence arc holds for every
+        fairness-portfolio lane: high utilization after learning,
+        never an overage (balanced fairness may leave a little more
+        slack by design — the insensitivity truncation — so its floor
+        is the only relaxed one)."""
+        sim, reporter = run_scenario(name, run_for=300)
+        s = reporter.summary()
+        floor = 0.75 if name == "1_balanced" else 0.85
+        assert s["utilization"] > floor, (name, s)
+        assert s["overage_events"] == 0, (name, s)
+
     def test_scenario_two_master_loss_before_expiry(self):
         sim, reporter = run_scenario("2", run_for=300)
         # Re-election at T=140 lands within the 60s lease: clients keep
