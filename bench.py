@@ -47,6 +47,10 @@ import numpy as np
 # while BENCH_r04 recorded backend_unreachable).
 _EMITTED: list = []
 _DIAGNOSTICS: list = []
+# Rows carried forward from the previous artifact on --only runs (a
+# stage subset must not discard the other stages' standing rows); a
+# re-measured metric replaces its carried-forward row.
+_PRESEEDED: list = []
 _PLATFORM_INFO: dict = {}
 # Set by _preflight() when the run degraded to the forced-multi-device
 # CPU fallback ("backend_unreachable" / "single_device" / ...): every
@@ -165,7 +169,13 @@ def write_artifact(complete: bool = True) -> None:
         # False marks a partial artifact (run still going, or died
         # mid-run): the results list holds everything emitted so far.
         "complete": complete,
-        "results": _EMITTED,
+        "results": [
+            row
+            for row in _PRESEEDED
+            if row.get("metric")
+            not in {r.get("metric") for r in _EMITTED}
+        ]
+        + _EMITTED,
         # Infrastructure conditions (probe failures etc.) — never
         # measurements; kept apart so tooling can't mistake them.
         "diagnostics": _DIAGNOSTICS,
@@ -2676,6 +2686,73 @@ def _preflight() -> None:
         )
 
 
+def bench_workload_scenarios() -> None:
+    """Closed-loop workload scenarios as standing bench rows: each
+    named scenario (doorman_tpu/workload) runs at its default scale on
+    the virtual clock and emits one row carrying its SLO verdict list
+    — so an admission, allocation, or election regression that moves a
+    scenario gate shows in the same artifact as the device rows, with
+    delta_vs_prev vs the prior BENCH round per verdict. No device
+    work; the run is seeded and virtual-clocked, so the row's
+    log_sha256 is a replay pin, not a measurement."""
+    from doorman_tpu.workload.scenarios import run_scenario
+
+    names = (
+        "diurnal", "flash_crowd", "rolling_deploy", "multi_region",
+        "elastic_preempt", "flash_crowd_predictive",
+    )
+    for name in names:
+        try:
+            v = run_scenario(name, scale=1.0, seed=0)
+        except Exception as e:
+            diagnostic({
+                "diagnostic": "workload_scenario_failed",
+                "scenario": name, "error": repr(e),
+            })
+            continue
+        verdicts = v["slo"]["verdicts"]
+        for verdict in verdicts:
+            # Let the bench's repo-rooted trajectory comparator supply
+            # the cross-round delta (the harness's in-run one has no
+            # prior artifact to diff against).
+            verdict.pop("delta_vs_prev", None)
+        emit(
+            {
+                "metric": f"workload_{name}",
+                "value": round(
+                    float(v["summary"].get("top_band_satisfaction", 0.0)),
+                    6,
+                ),
+                "unit": "top_band_satisfaction",
+                "ok": v["ok"],
+                "scenario": name,
+                "ticks": v["ticks"],
+                "log_sha256": v["log_sha256"],
+                "slo": verdicts,
+            },
+            artifact_extra={"summary": v["summary"]},
+        )
+
+
+def _preseed_artifact() -> None:
+    """Load the previous doc/bench_last.json rows so an --only run's
+    artifact keeps the stages it did not re-measure."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "doc",
+        "bench_last.json",
+    )
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except Exception:
+        return
+    _PRESEEDED.extend(
+        row for row in prior.get("results", []) if isinstance(row, dict)
+    )
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -2706,6 +2783,24 @@ if __name__ == "__main__":
              "visible; a diagnostic is emitted when fewer than "
              "max(requested, 2) are available)",
     )
+    _STAGES = {
+        "solver": main,
+        "tick_wide": bench_server_tick_wide,
+        "tick_wide_mesh": bench_server_tick_wide_mesh,
+        "rpc_storm": bench_server_rpc_storm,
+        "push_vs_poll": bench_server_push_vs_poll,
+        "stream_fanout": bench_server_stream_fanout_scaling,
+        "federated_roots": bench_server_tick_federated_roots,
+        "workload": bench_workload_scenarios,
+        "server_tick": bench_server_tick,
+    }
+    _ap.add_argument(
+        "--only", default="",
+        help="comma-separated stage subset to run instead of the full "
+             f"sequence (stages: {','.join(_STAGES)}). The artifact "
+             "pre-seeds from the existing doc/bench_last.json, so "
+             "rows from stages not re-run carry forward",
+    )
     _args = _ap.parse_args()
     if _args.churn:
         _tiers = sorted(
@@ -2716,34 +2811,53 @@ if __name__ == "__main__":
             _ap.error("--churn fractions must be in (0, 1]")
         SCOPED_CHURN_TIERS = tuple(_tiers)
     MESH_BENCH_DEVICES = max(_args.mesh_devices, 0)
+    _only = [s.strip() for s in _args.only.split(",") if s.strip()]
+    _unknown = [s for s in _only if s not in _STAGES]
+    if _unknown:
+        _ap.error(f"unknown --only stages: {','.join(_unknown)}")
+    if _only:
+        # Before anything emits: the preflight/gate rows below already
+        # rewrite the artifact, which would clobber what we carry over.
+        _preseed_artifact()
     if _args.trace:
         _trace_mod.default_tracer().enable()
     _preflight()
     gate_pallas_kernels()
     try:
-        # Opt-in device-side timeline around the measured solve.
-        with _trace_mod.jax_capture(_args.jax_trace or None):
-            main()
-        bench_server_tick_wide()
-        # After the 1-device wide bench, so scaling_vs_1device can read
-        # its median from this run's emitted results.
-        bench_server_tick_wide_mesh()
-        # RPC front-end under storm (no device work; rides along so
-        # admission regressions show in the same artifact).
-        bench_server_rpc_storm()
-        # Streaming lease push vs the polling population (no device
-        # work): steady-state RPC reduction + grant propagation.
-        bench_server_push_vs_poll()
-        # Sharded fan-out engine: fan-out wall time vs subscriber
-        # count (sublinearity SLO floor), quiet-tick independence, and
-        # the multiplexed storm driver's held-stream count.
-        bench_server_stream_fanout_scaling()
-        # Federated root tier: N shards ticking concurrently on their
-        # own devices — aggregate leases/sec + scaling_vs_1root.
-        bench_server_tick_federated_roots()
-        # The narrow server tick stays LAST: the driver parses the final
-        # JSON line as the round's headline metric.
-        bench_server_tick()
+        if _only:
+            for _stage in _only:
+                if _stage == "solver":
+                    with _trace_mod.jax_capture(_args.jax_trace or None):
+                        main()
+                else:
+                    _STAGES[_stage]()
+        else:
+            # Opt-in device-side timeline around the measured solve.
+            with _trace_mod.jax_capture(_args.jax_trace or None):
+                main()
+            bench_server_tick_wide()
+            # After the 1-device wide bench, so scaling_vs_1device can
+            # read its median from this run's emitted results.
+            bench_server_tick_wide_mesh()
+            # RPC front-end under storm (no device work; rides along so
+            # admission regressions show in the same artifact).
+            bench_server_rpc_storm()
+            # Streaming lease push vs the polling population (no device
+            # work): steady-state RPC reduction + grant propagation.
+            bench_server_push_vs_poll()
+            # Sharded fan-out engine: fan-out wall time vs subscriber
+            # count (sublinearity SLO floor), quiet-tick independence,
+            # and the multiplexed storm driver's held-stream count.
+            bench_server_stream_fanout_scaling()
+            # Federated root tier: N shards ticking concurrently on
+            # their own devices — aggregate leases/sec + scaling_vs_1root.
+            bench_server_tick_federated_roots()
+            # Closed-loop workload scenarios: SLO-gated verdict rows
+            # (no device work; replay-pinned by log_sha256).
+            bench_workload_scenarios()
+            # The narrow server tick stays LAST: the driver parses the
+            # final JSON line as the round's headline metric.
+            bench_server_tick()
     finally:
         # A crash mid-sequence still flushes everything emitted so far
         # (emit() also writes incrementally; this is the completeness

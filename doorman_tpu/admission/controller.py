@@ -94,6 +94,10 @@ class AimdController:
         self.max_retry_after = max_retry_after
 
         self.level = 1.0
+        # Predictive leg (workload forecaster seam): an externally
+        # supplied demand forecast in offered RPS for the NEXT window.
+        # None (the default) leaves the controller purely reactive.
+        self.forecast_rps: Optional[float] = None
         self._bands: List[int] = []  # sorted ascending wire priority
         self._arrivals = 0           # this window, shed included
         self._last_rate = 0.0        # previous closed window, per second
@@ -115,6 +119,16 @@ class AimdController:
     def observe_tick_lag(self, ratio: float) -> None:
         self._tick_lag.observe(ratio)
 
+    def set_forecast(self, rps: Optional[float]) -> None:
+        """Feed a demand forecast (offered RPS expected in the next
+        window) from a predictive model. The forecast joins the
+        pressure max scaled by ``max_rps`` like the measured rate, so
+        a predicted storm multiplies the level DOWN at the boundary
+        *entering* the spike instead of the one after it. Requires
+        ``max_rps`` to be configured (there is no budget to scale
+        against otherwise); pass None to drop back to reactive-only."""
+        self.forecast_rps = None if rps is None else float(rps)
+
     # -- the control loop ------------------------------------------------
 
     def pressure(self) -> float:
@@ -122,6 +136,8 @@ class AimdController:
         p = 0.0
         if self.max_rps is not None:
             p = self._last_rate / self.max_rps
+            if self.forecast_rps is not None:
+                p = max(p, self.forecast_rps / self.max_rps)
         p = max(p, self._lat.value / self.target_latency_s)
         p = max(p, self._queue.value / self.target_queue)
         p = max(p, self._tick_lag.value / self.target_tick_lag)
@@ -216,6 +232,10 @@ class AimdController:
             "max_rps": self.max_rps,
             "pressure": round(self.pressure(), 6),
             "offered_rps_last_window": round(self._last_rate, 3),
+            "forecast_rps": (
+                None if self.forecast_rps is None
+                else round(self.forecast_rps, 3)
+            ),
             "latency_ewma_s": round(self._lat.value, 6),
             "queue_ewma": round(self._queue.value, 3),
             "tick_lag_ewma": round(self._tick_lag.value, 6),

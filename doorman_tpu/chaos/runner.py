@@ -83,7 +83,7 @@ class SteppedElection(Election):
         self._cb_master = on_is_master
         self._cb_current = on_current
 
-    async def step(self) -> None:
+    async def step(self, *, campaign: bool = True) -> None:
         now = self._clock()
         if self.is_master:
             if now >= self._next_renew:
@@ -92,7 +92,7 @@ class SteppedElection(Election):
                 else:
                     self.is_master = False
                     await self._cb_master(False)
-        else:
+        elif campaign:
             try:
                 won = await self._kv.acquire(self._lock, self._id, self._ttl)
             except FaultInjected:
@@ -111,6 +111,16 @@ class SteppedElection(Election):
         if current != self._last_current:
             self._last_current = current
             await self._cb_current(current)
+
+    async def abdicate(self) -> None:
+        """Graceful step-down (a rolling deploy's drain): flip the
+        mastership state and tell the server, without touching the KV —
+        the caller decides whether the lock is also released (expire)
+        or left to lapse. With step(campaign=False) the candidate stays
+        out of the next election until it rejoins."""
+        if self.is_master:
+            self.is_master = False
+            await self._cb_master(False)
 
     async def _refresh_with_retry(self) -> bool:
         """One transient transport failure retries within the renewal

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from doorman_tpu.admission.policy import RETRY_AFTER_KEY
+from doorman_tpu.loadtest.ratecurve import ArrivalSampler, RateCurve
 from doorman_tpu.proto import doorman_pb2 as pb
 from doorman_tpu.proto.grpc_api import CapacityStub
 from doorman_tpu.utils import flagenv
@@ -49,6 +50,51 @@ from doorman_tpu.utils import flagenv
 log = logging.getLogger("doorman.loadtest.storm")
 
 __all__ = ["run_storm", "percentile"]
+
+
+class _Pacer:
+    """Open-loop offered-rate pacing (``--rate-curve``): a background
+    task releases request permits per the curve's trapezoid integral
+    over small real-time steps; each worker blocks on a permit before
+    every RPC. The offered rate then follows the schedule instead of
+    the server's response latency — the storm turns from closed-loop
+    (back-to-back) into a rate-driven load shape."""
+
+    def __init__(self, sampler: ArrivalSampler, step: float = 0.05):
+        self._sampler = sampler
+        self._step = step
+        self._sem = asyncio.Semaphore(0)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self, deadline: float) -> None:
+        self._task = asyncio.ensure_future(self._run(deadline))
+
+    async def _run(self, deadline: float) -> None:
+        start = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                return
+            t0 = now - start
+            await asyncio.sleep(min(self._step, deadline - now))
+            t1 = time.monotonic() - start
+            for _ in range(self._sampler.take(t0, t1)):
+                self._sem.release()
+
+    async def acquire(self, deadline: float) -> bool:
+        """Block until a permit or the deadline; False means go home."""
+        try:
+            await asyncio.wait_for(
+                self._sem.acquire(),
+                timeout=max(deadline - time.monotonic(), 0.0),
+            )
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
 
 
 def percentile(sorted_values: List[float], q: float) -> float:
@@ -83,6 +129,7 @@ async def _worker(
     rng: random.Random,
     honor_retry_after: bool,
     rpc_timeout: Optional[float],
+    pacer: Optional[_Pacer] = None,
 ) -> None:
     async with grpc.aio.insecure_channel(addr) as channel:
         stub = CapacityStub(channel)
@@ -92,6 +139,8 @@ async def _worker(
         rr.wants = wants
         rr.priority = band
         while time.monotonic() < deadline:
+            if pacer is not None and not await pacer.acquire(deadline):
+                return
             t0 = time.monotonic()
             try:
                 out = await stub.GetCapacity(request, timeout=rpc_timeout)
@@ -436,6 +485,8 @@ async def run_storm(
     stream: bool = False,
     streams_per_worker: int = 1,
     resource_spread: int = 1,
+    rate_curve: "Optional[RateCurve | str]" = None,
+    rate_jitter: float = 0.0,
 ) -> Dict:
     """Drive `workers` closed-loop GetCapacity clients (round-robin
     over `bands`) for `duration` seconds; returns aggregate stats with
@@ -443,7 +494,11 @@ async def run_storm(
     ``stream=True`` the workers hold WatchCapacity streams instead:
     ``ok``/``latencies`` become establishment counts/latencies,
     ``pushes`` counts received deltas, and shed establishments honor
-    the retry-after hint before reconnecting."""
+    the retry-after hint before reconnecting. ``rate_curve`` (a
+    RateCurve or its ``"t:rate,..."`` text form) switches the poll
+    storm to open-loop pacing: offered rate follows the piecewise-
+    linear schedule (with optional seeded multiplicative
+    ``rate_jitter``) instead of the server's response latency."""
     stats: Dict = {
         "ok": 0, "shed": 0, "errors": 0, "redirects": 0,
         "ok_by_band": {}, "shed_by_band": {}, "latencies": [],
@@ -453,8 +508,24 @@ async def run_storm(
         stats["pushes"] = 0
         stats["resets"] = 0
     rng = random.Random(seed)
+    pacer: Optional[_Pacer] = None
+    if rate_curve is not None:
+        if stream:
+            raise ValueError(
+                "--rate-curve paces the closed-loop poll storm; "
+                "stream mode holds long-lived subscriptions and has "
+                "no per-request rate to pace"
+            )
+        if isinstance(rate_curve, str):
+            rate_curve = RateCurve.parse(rate_curve)
+        pacer = _Pacer(ArrivalSampler(
+            rate_curve, jitter=rate_jitter,
+            rng=random.Random(rng.random()),
+        ))
     deadline = time.monotonic() + duration
     start = time.monotonic()
+    if pacer is not None:
+        pacer.start(deadline)
     if stream and streams_per_worker > 1:
         await asyncio.gather(*(
             _mux_worker(
@@ -478,10 +549,12 @@ async def run_storm(
             _worker(
                 i, addr, resource, bands[i % len(bands)], wants,
                 deadline, stats, random.Random(rng.random()),
-                honor_retry_after, rpc_timeout,
+                honor_retry_after, rpc_timeout, pacer,
             )
             for i in range(workers)
         ))
+    if pacer is not None:
+        pacer.stop()
     elapsed = max(time.monotonic() - start, 1e-9)
     lat = sorted(stats.pop("latencies"))
     lat_by_band = {
@@ -539,6 +612,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="stream mode: multiplex this many streams per "
                         "worker over one shared channel (100k streams "
                         "without 100k tasks/channels)")
+    p.add_argument("--rate-curve", default="",
+                   help="open-loop offered-rate schedule "
+                        "'t:rate,t:rate,...' (piecewise-linear, e.g. "
+                        "'0:5,30:50,60:5'); empty keeps the closed-"
+                        "loop back-to-back storm")
+    p.add_argument("--rate-jitter", type=float, default=0.0,
+                   help="seeded multiplicative jitter on each pacing "
+                        "step's expected arrivals, in [0, 1)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="RNG seed for retry jitter and rate-curve "
+                        "jitter")
     p.add_argument("--resource-spread", type=int, default=1,
                    help="multiplexed stream mode: fan subscriptions "
                         "over this many resources (<resource>-<k>) so "
@@ -560,9 +644,12 @@ def main(argv=None) -> None:
         wants=args.wants,
         honor_retry_after=not args.ignore_retry_after,
         rpc_timeout=args.rpc_timeout or None,
+        seed=args.seed,
         stream=args.stream,
         streams_per_worker=args.streams_per_worker,
         resource_spread=args.resource_spread,
+        rate_curve=args.rate_curve or None,
+        rate_jitter=args.rate_jitter,
     ))
     import json
 

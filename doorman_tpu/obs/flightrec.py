@@ -248,11 +248,18 @@ class FlightRecorder:
             # the tick actually solved — a counter stuck at the table
             # size means solve_mode is stuck at full, doc/
             # operations.md).
+            # population / offered / forecast_rps are the workload
+            # harness's per-tick beat (doorman_tpu/workload): live
+            # client count, offered refreshes this tick, and the
+            # forecaster's next-tick demand prediction — overlaying
+            # forecast_rps on offered shows the predictive-admission
+            # lead time directly.
             for counter in ("admission_level", "persist_seq",
                             "straddle_capacity", "straddle_updates",
                             "upstream_rpcs", "dispatches",
                             "host_syncs", "scoped_rows",
-                            "scoped_resources"):
+                            "scoped_resources", "population",
+                            "offered", "forecast_rps"):
                 v = rec.get(counter)
                 if isinstance(v, (int, float)):
                     events.append({

@@ -49,6 +49,7 @@ __all__ = [
     "TrajectoryComparator",
     "bench_verdict",
     "histogram_quantile",
+    "predictive_goodput_verdict",
     "reconvergence_spec",
     "sample_quantile",
     "server_slos",
@@ -56,6 +57,7 @@ __all__ = [
     "top_band_goodput_spec",
     "tpu_tick_budget_spec",
     "tpu_tick_verdict",
+    "workload_slos",
 ]
 
 # The north-star tick budget (BASELINE.md): recompute every lease of the
@@ -446,6 +448,158 @@ def storm_slo_verdicts(
     return SloEngine(specs).evaluate(
         SloInputs(scalars=scalars, band_tallies=tallies)
     )
+
+
+# The workload harness's gate vocabulary: gate name -> how it is
+# observed. Each entry is (kind, source, unit, description); the
+# scenario's spec.gates mapping picks gates by name and sets targets.
+_WORKLOAD_GATES: Dict[str, tuple] = {
+    "top_band_satisfaction": (
+        "min",
+        {"type": "scalar", "key": "top_band_satisfaction"},
+        "ratio",
+        "mean granted/wanted for the top band over measured ticks",
+    ),
+    "stress_satisfaction": (
+        "min",
+        {"type": "scalar", "key": "top_band_satisfaction_stress"},
+        "ratio",
+        "top-band satisfaction over the scenario's stress ticks "
+        "(e.g. later flash-crowd windows)",
+    ),
+    "satisfaction": (
+        "min",
+        {"type": "scalar", "key": "satisfaction_overall"},
+        "ratio",
+        "mean granted/wanted across all bands over measured ticks",
+    ),
+    "top_band_goodput": (
+        "min",
+        {"type": "band_goodput"},
+        "ratio",
+        "admitted/(admitted+shed) of the top band (admission tallies)",
+    ),
+    "get_capacity_p99_ms": (
+        "max",
+        {"type": "samples", "stream": "get_capacity_wall_ms",
+         "quantile": 0.99},
+        "ms",
+        "wall-clock GetCapacity p99 over the run (loopback)",
+    ),
+    "refresh_virtual_p99_ms": (
+        "max",
+        {"type": "samples", "stream": "refresh_virtual_ms",
+         "quantile": 0.99},
+        "ms",
+        "virtual refresh latency p99 incl. the region RTT model",
+    ),
+    "reconverge_ticks": (
+        "max",
+        {"type": "scalar", "key": "reconverge_ticks"},
+        "ticks",
+        "ticks after the disturbance ends until base-client "
+        "allocations match their baseline snapshot",
+    ),
+    "completions": (
+        "min",
+        {"type": "scalar", "key": "completions"},
+        "jobs",
+        "elastic jobs that reached total_work",
+    ),
+    "preemptions": (
+        "min",
+        {"type": "scalar", "key": "preemptions"},
+        "jobs",
+        "elastic preemption events (the scenario must exercise them)",
+    ),
+    "peak_population": (
+        "min",
+        {"type": "scalar", "key": "peak_population"},
+        "clients",
+        "max concurrent client population (the curve visibly moved)",
+    ),
+    "master_changes": (
+        "min",
+        {"type": "scalar", "key": "master_changes"},
+        "changes",
+        "mastership handovers observed (deploys visibly happened)",
+    ),
+    "refresh_ok_ratio": (
+        "min",
+        {"type": "scalar", "key": "refresh_ok_ratio"},
+        "ratio",
+        "successful refreshes / attempted, whole run",
+    ),
+    "fed_capacity_violations": (
+        "max",
+        {"type": "scalar", "key": "fed_capacity_violations"},
+        "violations",
+        "federated capacity-sum invariant violations (must be 0)",
+    ),
+    "stream_pushes": (
+        "min",
+        {"type": "scalar", "key": "stream_pushes"},
+        "pushes",
+        "lease deltas pushed to WatchCapacity subscribers",
+    ),
+}
+
+
+def workload_slos(
+    gates: Dict[str, float], *, name_prefix: str
+) -> List[SloSpec]:
+    """Build the spec list for a workload scenario from its gate map
+    (gate name -> target). Unknown gate names raise — a typo'd gate
+    must fail the scenario author, not silently pass the run."""
+    specs = []
+    for gate, target in sorted(gates.items()):
+        if gate not in _WORKLOAD_GATES:
+            raise ValueError(
+                f"unknown workload gate {gate!r} "
+                f"(known: {sorted(_WORKLOAD_GATES)})"
+            )
+        kind, source, unit, description = _WORKLOAD_GATES[gate]
+        specs.append(SloSpec(
+            name=f"{name_prefix}:{gate}",
+            kind=kind,
+            target=float(target),
+            source=dict(source),
+            unit=unit,
+            description=description,
+        ))
+    return specs
+
+
+def predictive_goodput_verdict(
+    predictive: float,
+    reactive: float,
+    *,
+    name: str = "workload:flash_crowd_predictive:predictive_over_reactive",
+) -> dict:
+    """The standing predictive-vs-reactive head-to-head verdict: the
+    predictive run's stressed top-band satisfaction must be at least
+    the reactive run's (same scenario, same seed, forecaster on/off).
+    The reactive observation IS the target, so the verdict and its
+    round-over-round delta track the predictive margin directly."""
+    spec = SloSpec(
+        name=name,
+        kind="min",
+        target=round(float(reactive), 6),
+        source={"type": "scalar", "key": "predictive"},
+        unit="ratio",
+        description=(
+            "predictive top-band satisfaction over the stressed flash-"
+            "crowd windows vs the reactive controller's (the target)"
+        ),
+    )
+    verdict = SloEngine([spec]).evaluate(
+        SloInputs(scalars={"predictive": float(predictive)})
+    )[0]
+    verdict["detail"] = {
+        "predictive": round(float(predictive), 6),
+        "reactive": round(float(reactive), 6),
+    }
+    return verdict
 
 
 # ----------------------------------------------------------------------

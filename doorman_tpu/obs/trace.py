@@ -93,11 +93,18 @@ KNOWN_SPAN_NAMES = frozenset({
     "federation.reconcile",
     "federation.aggregate",
     "federation.*",
+    # Workload harness (doorman_tpu/workload): one span per scenario
+    # run, wrapping the whole stepped drive.
+    "workload.scenario",
 })
 KNOWN_INSTANT_NAMES = frozenset({
     "election.transition",
     "shard.*",  # per-direction mesh transfer instants: shard.upload, ...
     "federation.*",  # e.g. federation.partition from the chaos seam
+    # Workload event-log entries mirrored onto the trace timeline:
+    # workload.crowd_start, workload.deploy, workload.elastic_preempt,
+    # ... (harness.note stamps workload.<kind>).
+    "workload.*",
 })
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must

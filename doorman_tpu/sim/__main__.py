@@ -14,13 +14,25 @@ def main() -> None:
     from doorman_tpu.sim.scenarios import SCENARIOS
 
     parser.add_argument(
-        "scenario", choices=sorted(SCENARIOS) + ["all"]
+        "scenario", nargs="?", default=None,
+        choices=sorted(SCENARIOS) + ["all"],
     )
+    parser.add_argument("--list-scenarios", action="store_true",
+                        help="list scenarios with one-line docs and exit")
     parser.add_argument("--run-for", type=float, default=None)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--csv", action="store_true", help="write CSV report")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
+
+    if args.list_scenarios:
+        from doorman_tpu.sim.scenarios import registry_lines
+
+        for name, doc in registry_lines(SCENARIOS):
+            print(f"{name:12s} {doc}")
+        return
+    if args.scenario is None:
+        parser.error("a scenario is required (or --list-scenarios)")
 
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

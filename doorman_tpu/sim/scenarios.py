@@ -20,6 +20,7 @@ from doorman_tpu.sim.reporter import Reporter
 
 
 def scenario_one(sim: Sim, reporter: Reporter) -> None:
+    """Convergence: one root job x3 tasks, 5 clients, fluctuating demand."""
     job = ServerJob(sim, "root", 0, 3)
     for _ in range(5):
         c = SimClient(sim, "client", job)
@@ -39,18 +40,21 @@ def _master_loss(sim: Sim, reporter: Reporter, reelect_at: float) -> None:
 
 
 def scenario_two(sim: Sim, reporter: Reporter) -> None:
+    """Master loss at T=120, re-election at T=140 (before lease expiry)."""
     # Re-election before the 60s leases expire: clients keep capacity.
     _master_loss(sim, reporter, reelect_at=140)
     reporter.set_filename("scenario_two")
 
 
 def scenario_three(sim: Sim, reporter: Reporter) -> None:
+    """Master loss at T=120, re-election at T=190 (after lease expiry)."""
     # Re-election after lease expiry: clients drop to zero, then recover.
     _master_loss(sim, reporter, reelect_at=190)
     reporter.set_filename("scenario_three")
 
 
 def scenario_four(sim: Sim, reporter: Reporter) -> None:
+    """Two-level tree: root plus one DC job."""
     root = ServerJob(sim, "root", 0, 3)
     dc = ServerJob(sim, "dc", 1, 3, root)
     for _ in range(5):
@@ -61,6 +65,7 @@ def scenario_four(sim: Sim, reporter: Reporter) -> None:
 
 
 def scenario_five(sim: Sim, reporter: Reporter, num_clients: int = 5) -> None:
+    """Three-level tree: root, 3 regions x 3 DCs x 5 clients each."""
     root = ServerJob(sim, "root", 0, 3)
     for i in range(1, 4):
         region = ServerJob(sim, f"region:{i}", 1, 3, root)
@@ -74,6 +79,7 @@ def scenario_five(sim: Sim, reporter: Reporter, num_clients: int = 5) -> None:
 
 
 def scenario_six(sim: Sim, reporter: Reporter) -> None:
+    """Demand spike to 1000 on two clients at T=150."""
     job = ServerJob(sim, "root", 0, 3)
     clients = []
     for _ in range(5):
@@ -91,6 +97,7 @@ def scenario_six(sim: Sim, reporter: Reporter) -> None:
 
 
 def scenario_seven(sim: Sim, reporter: Reporter) -> None:
+    """Scenario 5 plus a random mishap every 60s for a simulated hour."""
     scenario_five(sim, reporter)
     reporter.set_filename("scenario_seven")
 
@@ -157,6 +164,9 @@ def _scenario_one_lane(wire_kind: str, variant: "str | None"):
         reporter.schedule("resource0")
         reporter.set_filename(f"scenario_one_{variant or 'fair'}")
 
+    scenario.__doc__ = (
+        f"Scenario-one convergence arc on the {variant or 'fair'} lane."
+    )
     return scenario
 
 
@@ -176,6 +186,20 @@ SCENARIOS: Dict[str, Callable[[Sim, Reporter], None]] = {
 }
 
 DEFAULT_DURATION: Dict[str, float] = {"7": 3600.0}
+
+
+def registry_lines(registry: "Dict[str, Callable]") -> "list":
+    """[(name, one-line doc), ...] for a scenario registry — what a
+    CLI's --list-scenarios prints. The one-liner is the factory
+    docstring's first line (the registry convention shared by the sim
+    and workload scenario libraries)."""
+    import inspect
+
+    return [
+        (name, (inspect.getdoc(fn) or "").splitlines()[0]
+         if inspect.getdoc(fn) else "")
+        for name, fn in sorted(registry.items())
+    ]
 
 
 def run_scenario(name: str, run_for: float | None = None, seed: int = 0,

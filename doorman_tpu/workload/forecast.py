@@ -1,0 +1,237 @@
+"""Device-batched seasonal demand forecaster for predictive admission.
+
+A Holt–Winters additive-seasonal model over per-band offered-rate
+history (the flight recorder's per-tick series): one EWMA level per
+series plus a seasonal correction per slot of a fixed period. Each
+``observe(x)`` folds in one tick's rates and returns the forecast for
+the NEXT tick, clamped to the min/max envelope of everything seen so
+far — a forecast is a claim about recurring traffic, not an
+extrapolation license:
+
+    level'        = level + alpha * ((x - season[slot]) - level)
+    season[slot]' = season[slot] + beta * ((x - level') - season[slot])
+    forecast      = clip(level' + season[next_slot], hist_min, hist_max)
+
+The update is elementwise over the batch of series (bands), so the
+device path is one fused jitted step over float32 arrays — the
+"device-batched Learn mode" of the tentpole. Per the PR-15 oracle
+discipline, the numpy host path is the ORACLE and the device path is
+pinned bit-identical to it (tests/test_forecast.py). Bit parity across
+compilers follows the repo's exactly-representable convention: the
+gains ``alpha``/``beta`` are constrained to powers of two, so every
+multiply in the delta-form update scales by a power of two and is
+EXACT in float32 — an fma-fusing backend rounds each fused
+multiply-add exactly once, the same place numpy's separate ops round,
+and no expression can diverge. (The general convex form
+``a*x + (1-a)*y`` has two inexact products and IS fma-sensitive; the
+delta form with dyadic gains is why this model replays bit-for-bit.)
+
+Two invariants hold by construction (hypothesis-tested):
+
+  * the forecast never leaves the historical [min, max] envelope
+    (the final clip);
+  * constant traffic is a fixpoint: after the first observation of a
+    constant series the forecast equals the constant exactly (level
+    initializes to x, every seasonal correction stays 0).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SeasonalForecaster", "host_step", "device_available"]
+
+# State tuple: (level[B], season[B, P], hist_min[B], hist_max[B],
+# seen[B] as float32 0/1). Everything float32: the device path computes
+# in f32 and the oracle must match it bit for bit.
+State = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def _dyadic(gain: float) -> bool:
+    """True for 0 or a power of two in (0, 1] — the gains whose f32
+    products are exact (see module docstring)."""
+    if gain == 0.0:
+        return True
+    if not 0.0 < gain <= 1.0:
+        return False
+    return math.frexp(gain)[0] == 0.5
+
+
+def init_state(series: int, period: int) -> State:
+    if series < 1:
+        raise ValueError(f"series must be >= 1, got {series}")
+    if period < 1:
+        raise ValueError(f"period must be >= 1, got {period}")
+    return (
+        np.zeros(series, np.float32),
+        np.zeros((series, period), np.float32),
+        np.zeros(series, np.float32),
+        np.zeros(series, np.float32),
+        np.zeros(series, np.float32),
+    )
+
+
+def host_step(
+    state: State, x: np.ndarray, slot: int, nxt: int,
+    alpha: float, beta: float,
+) -> Tuple[State, np.ndarray]:
+    """One numpy oracle step: fold in x (float32[B]) at seasonal slot
+    `slot`, forecast for slot `nxt`. The device step mirrors these
+    expressions operation for operation."""
+    level, season, hist_min, hist_max, seen = state
+    a = np.float32(alpha)
+    b = np.float32(beta)
+    s = season[:, slot]
+    level2 = np.where(seen > 0, level + a * ((x - s) - level), x)
+    season_slot = np.where(
+        seen > 0, s + b * ((x - level2) - s), s
+    )
+    hist_min2 = np.where(seen > 0, np.minimum(hist_min, x), x)
+    hist_max2 = np.where(seen > 0, np.maximum(hist_max, x), x)
+    season2 = season.copy()
+    season2[:, slot] = season_slot
+    forecast = np.clip(level2 + season2[:, nxt], hist_min2, hist_max2)
+    seen2 = np.ones_like(seen)
+    return (
+        (level2, season2, hist_min2, hist_max2, seen2),
+        forecast.astype(np.float32),
+    )
+
+
+_DEVICE_STEP = None
+_DEVICE_OK: Optional[bool] = None
+
+
+def device_available() -> bool:
+    """True when jax imports and can build the jitted step."""
+    return _get_device_step() is not None
+
+
+def _get_device_step():
+    global _DEVICE_STEP, _DEVICE_OK
+    if _DEVICE_OK is not None:
+        return _DEVICE_STEP
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(level, season, hist_min, hist_max, seen, x, slot, nxt,
+                 alpha, beta):
+            # The oracle's expressions, same order, f32 throughout.
+            a = alpha.astype(jnp.float32)
+            b = beta.astype(jnp.float32)
+            s = season[:, slot]
+            level2 = jnp.where(
+                seen > 0, level + a * ((x - s) - level), x
+            )
+            season_slot = jnp.where(
+                seen > 0, s + b * ((x - level2) - s), s
+            )
+            hist_min2 = jnp.where(seen > 0, jnp.minimum(hist_min, x), x)
+            hist_max2 = jnp.where(seen > 0, jnp.maximum(hist_max, x), x)
+            season2 = season.at[:, slot].set(season_slot)
+            forecast = jnp.clip(
+                level2 + season2[:, nxt], hist_min2, hist_max2
+            )
+            seen2 = jnp.ones_like(seen)
+            return (
+                level2, season2, hist_min2, hist_max2, seen2, forecast
+            )
+
+        _DEVICE_STEP = step
+        _DEVICE_OK = True
+    except Exception:  # jax missing or backend init failed
+        _DEVICE_STEP = None
+        _DEVICE_OK = False
+    return _DEVICE_STEP
+
+
+class SeasonalForecaster:
+    """Batched Holt–Winters forecaster over `series` parallel rate
+    series with seasonal period `period` (in ticks).
+
+    alpha/beta must be 0 or a power of two in (0, 1] (the bit-parity
+    constraint in the module docstring); beta=0 disables the seasonal
+    leg and leaves a plain EWMA.
+
+    engine: "auto" (device when jax is importable, else host),
+    "host" (force the numpy oracle), "device" (force jax; raises if
+    unavailable)."""
+
+    def __init__(
+        self,
+        series: int,
+        period: int,
+        *,
+        alpha: float = 0.5,
+        beta: float = 0.25,
+        engine: str = "auto",
+    ):
+        if alpha == 0.0 or not _dyadic(alpha):
+            raise ValueError(
+                f"alpha must be a power of two in (0, 1], got {alpha}"
+            )
+        if not _dyadic(beta):
+            raise ValueError(
+                f"beta must be 0 or a power of two in (0, 1], "
+                f"got {beta}"
+            )
+        if engine not in ("auto", "host", "device"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.series = int(series)
+        self.period = int(period)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self._state = init_state(self.series, self.period)
+        self._t = 0
+        if engine == "auto":
+            engine = "device" if _get_device_step() else "host"
+        elif engine == "device" and _get_device_step() is None:
+            raise RuntimeError("jax unavailable: no device forecaster")
+        self.engine = engine
+
+    @property
+    def ticks_observed(self) -> int:
+        return self._t
+
+    def observe(self, x: Sequence[float]) -> np.ndarray:
+        """Fold in one tick's per-series rates; return float32[B]
+        forecast for the next tick."""
+        arr = np.asarray(x, np.float32)
+        if arr.shape != (self.series,):
+            raise ValueError(
+                f"expected {self.series} rates, got shape {arr.shape}"
+            )
+        slot = self._t % self.period
+        nxt = (self._t + 1) % self.period
+        if self.engine == "device":
+            step = _get_device_step()
+            out = step(
+                *self._state, arr,
+                np.int32(slot), np.int32(nxt),
+                np.float32(self.alpha), np.float32(self.beta),
+            )
+            self._state = tuple(np.asarray(v) for v in out[:5])
+            forecast = np.asarray(out[5])
+        else:
+            self._state, forecast = host_step(
+                self._state, arr, slot, nxt, self.alpha, self.beta
+            )
+        self._t += 1
+        return forecast
+
+    def status(self) -> dict:
+        level, _, hist_min, hist_max, seen = self._state
+        return {
+            "engine": self.engine,
+            "period": self.period,
+            "ticks_observed": self._t,
+            "level": [round(float(v), 3) for v in level],
+            "hist_min": [round(float(v), 3) for v in hist_min],
+            "hist_max": [round(float(v), 3) for v in hist_max],
+            "seen": bool(seen.any()),
+        }

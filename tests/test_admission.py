@@ -529,3 +529,67 @@ def test_admission_metrics_in_default_registry():
         'outcome="admitted"}' in text
     )
     assert "doorman_admission_window_occupancy" in text
+
+
+def test_level_recovers_within_documented_window_on_chaos_clock():
+    """Regression pin for the documented recovery window (doc/
+    admission.md): from the floor, full admission returns within
+    ceil((1 - min_level) / ai_step) healthy control windows — 10 with
+    defaults. Driven by the ChaosClock so the windows are exact."""
+    import math
+
+    from doorman_tpu.chaos.clock import ChaosClock
+
+    clock = ChaosClock()
+    ctl = AimdController(
+        window=1.0, clock=clock, rng=random.Random(0), max_rps=10.0
+    )
+    # Storm to the floor: 40 arrivals/window until min_level holds.
+    for _ in range(8):
+        for _ in range(40):
+            ctl.admit(0)
+        clock.advance(1.0)
+    assert ctl.level == ctl.min_level
+    budget = math.ceil((1.0 - ctl.min_level) / ctl.ai_step)
+    # Healthy windows: one calm arrival each; the level must be back
+    # at 1.0 within the documented budget (one extra window closes the
+    # last storm window's rate).
+    for k in range(budget + 1):
+        ctl.admit(0)
+        clock.advance(1.0)
+        if ctl.level == 1.0:
+            break
+    assert ctl.level == 1.0, (k, ctl.level)
+    assert k <= budget, (k, budget)
+
+
+def test_forecast_seam_folds_into_pressure():
+    """The workload forecaster's seam: a demand forecast above max_rps
+    multiplies the level down at the NEXT boundary even though the
+    observed rate is calm — and clearing the forecast restores the
+    purely reactive controller."""
+    from doorman_tpu.chaos.clock import ChaosClock
+
+    clock = ChaosClock()
+    ctl = AimdController(
+        window=1.0, clock=clock, rng=random.Random(0), max_rps=10.0
+    )
+    for _ in range(3):
+        ctl.admit(0)
+        clock.advance(1.0)
+    assert ctl.level == 1.0
+    ctl.set_forecast(30.0)  # 3x the budget, observed rate still calm
+    ctl.admit(0)
+    clock.advance(1.0)
+    ctl.admit(0)  # boundary: pressure = forecast/max_rps = 3 -> MD
+    assert ctl.level < 1.0
+    level_after_md = ctl.level
+    ctl.set_forecast(None)
+    for _ in range(15):
+        ctl.admit(0)
+        clock.advance(1.0)
+    assert ctl.level == 1.0  # reactive again, recovered
+    assert level_after_md < 1.0
+    # status() reports the seam for debug pages.
+    ctl.set_forecast(12.5)
+    assert ctl.status()["forecast_rps"] == 12.5

@@ -1,0 +1,138 @@
+"""Seasonal forecaster: device/host bit-identity and the invariants
+the predictive-admission seam leans on.
+
+The bit-identity pin follows the repo's parity convention (see
+tests/test_fairness_lanes.py): the update is written in delta form
+with power-of-two gains, so every multiply is exact in float32 and
+XLA's FMA fusion rounds identically to numpy's separate ops — the
+device path must reproduce the numpy host oracle BIT-FOR-BIT, not
+approximately. The envelope invariant (forecasts clipped to the
+observed range) is what lets the admission controller trust an
+arbitrary forecast: a diverging season term can never demand a shed
+harder than the worst tick actually seen.
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.workload import forecast as fc
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - optional dependency
+    HAVE_HYPOTHESIS = False
+
+
+def _run_pair(series, period, ticks, seed, alpha=0.5, beta=0.25):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(0.0, 100.0, (ticks, series)).astype(np.float32)
+    host = fc.SeasonalForecaster(
+        series=series, period=period, alpha=alpha, beta=beta,
+        engine="host",
+    )
+    dev = fc.SeasonalForecaster(
+        series=series, period=period, alpha=alpha, beta=beta,
+        engine="device",
+    )
+    return xs, host, dev
+
+
+def test_device_path_is_bit_identical_to_host_oracle():
+    if not fc.device_available():
+        pytest.skip("no jax device path")
+    xs, host, dev = _run_pair(series=4, period=8, ticks=300, seed=42)
+    for t in range(xs.shape[0]):
+        h = host.observe(xs[t])
+        d = dev.observe(xs[t])
+        assert h.dtype == np.float32 and d.dtype == np.float32
+        np.testing.assert_array_equal(
+            h.view(np.uint32), d.view(np.uint32),
+            err_msg=f"bit divergence at tick {t}",
+        )
+
+
+def test_constant_traffic_is_an_exact_fixpoint():
+    f = fc.SeasonalForecaster(series=2, period=4, engine="host")
+    x = np.asarray([7.0, 0.0], np.float32)
+    for _ in range(40):
+        out = f.observe(x)
+    # Delta-form updates leave a constant series untouched: the level
+    # IS the rate, the season is exactly zero, forecast == rate.
+    np.testing.assert_array_equal(out, x)
+
+
+def test_forecast_stays_inside_the_observed_envelope():
+    rng = np.random.default_rng(3)
+    f = fc.SeasonalForecaster(series=3, period=5, engine="host")
+    lo = np.full(3, np.inf, np.float32)
+    hi = np.full(3, -np.inf, np.float32)
+    for _ in range(200):
+        x = rng.uniform(-50.0, 50.0, 3).astype(np.float32)
+        lo, hi = np.minimum(lo, x), np.maximum(hi, x)
+        out = f.observe(x)
+        assert (out >= lo).all() and (out <= hi).all()
+
+
+def test_non_dyadic_gains_are_rejected():
+    # The bit-parity convention requires power-of-two gains; anything
+    # else reintroduces FMA-sensitive rounding.
+    with pytest.raises(ValueError, match="power of two"):
+        fc.SeasonalForecaster(series=1, period=4, alpha=0.3)
+    with pytest.raises(ValueError, match="power of two"):
+        fc.SeasonalForecaster(series=1, period=4, beta=0.75)
+    fc.SeasonalForecaster(series=1, period=4, alpha=0.125, beta=1.0)
+
+
+def test_status_and_tick_accounting():
+    f = fc.SeasonalForecaster(series=2, period=4, engine="host")
+    for t in range(9):
+        f.observe(np.asarray([float(t), 1.0], np.float32))
+    s = f.status()
+    assert s["ticks_observed"] == 9 and s["period"] == 4
+    assert s["engine"] == "host" and s["seen"]
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        xs=st.lists(
+            st.floats(
+                min_value=0.0, max_value=1e6, allow_nan=False,
+                allow_infinity=False, width=32,
+            ),
+            min_size=1, max_size=60,
+        ),
+        period=st.integers(min_value=1, max_value=12),
+    )
+    def test_envelope_invariant_holds_for_any_stream(xs, period):
+        f = fc.SeasonalForecaster(series=1, period=period,
+                                  engine="host")
+        seen = []
+        for x in xs:
+            seen.append(np.float32(x))
+            out = f.observe(np.asarray([x], np.float32))
+            assert min(seen) <= out[0] <= max(seen)
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        x=st.floats(
+            min_value=0.0, max_value=1e6, allow_nan=False,
+            allow_infinity=False, width=32,
+        ),
+        period=st.integers(min_value=1, max_value=8),
+        ticks=st.integers(min_value=1, max_value=40),
+    )
+    def test_constant_fixpoint_holds_for_any_rate(x, period, ticks):
+        f = fc.SeasonalForecaster(series=1, period=period,
+                                  engine="host")
+        arr = np.asarray([x], np.float32)
+        out = arr
+        for _ in range(ticks):
+            out = f.observe(arr)
+        np.testing.assert_array_equal(out, arr)

@@ -620,14 +620,17 @@ def test_real_repo_clean_under_all_nine_rules():
 
 def test_wall_clock_budget_and_no_jax_import():
     # The lint job must stay a fast bare-CPU gate: the full nine-rule
-    # run over the real repo in under 10 s, without ever importing jax
-    # (fresh interpreter so this suite's own imports don't pollute).
+    # run over the real repo in under 10 s of CPU, without ever
+    # importing jax (fresh interpreter so this suite's own imports
+    # don't pollute). CPU time, not wall clock: the property is the
+    # work lint does, and on a single-core box the rest of the suite
+    # competing for the core would flake a wall-clock bound.
     code = (
-        "import sys, time; t0 = time.perf_counter();\n"
+        "import sys, time; t0 = time.process_time();\n"
         "from pathlib import Path;\n"
         "from tools.lint.core import run_lint;\n"
         f"fs = run_lint(Path({str(REPO_ROOT)!r}));\n"
-        "elapsed = time.perf_counter() - t0;\n"
+        "elapsed = time.process_time() - t0;\n"
         "assert 'jax' not in sys.modules, 'lint imported jax';\n"
         "assert 'numpy' not in sys.modules, 'lint imported numpy';\n"
         "print(elapsed)\n"
@@ -638,7 +641,7 @@ def test_wall_clock_budget_and_no_jax_import():
     )
     assert res.returncode == 0, res.stderr
     elapsed = float(res.stdout.strip().splitlines()[-1])
-    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s CPU (budget 10s)"
 
 
 def test_changed_only_filters_reporting(tree, capsys):

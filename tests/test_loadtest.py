@@ -180,3 +180,76 @@ resources:
         await server.stop()
 
     asyncio.run(body())
+
+
+# ----------------------------------------------------------------------
+# Rate curves (the schedule shared by storm --rate-curve and the
+# workload harness's diurnal generator)
+# ----------------------------------------------------------------------
+
+
+def test_rate_curve_parse_interpolate_and_integrate():
+    from doorman_tpu.loadtest.ratecurve import RateCurve
+
+    curve = RateCurve.parse("0:10,30:45,60:0")
+    assert curve.rate_at(0) == 10.0
+    assert curve.rate_at(15) == 27.5  # linear between knots
+    assert curve.rate_at(-5) == 10.0  # clamped before the first knot
+    assert curve.rate_at(90) == 0.0   # clamped after the last
+    # Trapezoid over the whole span: (10+45)/2*30 + 45/2*30 = 1500.
+    assert curve.integral(0, 60) == pytest.approx(1500.0)
+    assert curve.end_time == 60.0
+
+
+def test_rate_curve_rejects_garbage():
+    from doorman_tpu.loadtest.ratecurve import RateCurve
+
+    for bad in ("", "abc", "0:10,5", "10:5,0:10", "0:-3"):
+        with pytest.raises(ValueError):
+            RateCurve.parse(bad)
+
+
+def test_arrival_sampler_is_deterministic_and_tracks_the_curve():
+    from doorman_tpu.loadtest.ratecurve import ArrivalSampler, RateCurve
+
+    curve = RateCurve.parse("0:5,10:5")
+    a = ArrivalSampler(curve, jitter=0.3, rng=random.Random(11))
+    b = ArrivalSampler(curve, jitter=0.3, rng=random.Random(11))
+    counts_a = [a.take(t, t + 1.0) for t in range(10)]
+    counts_b = [b.take(t, t + 1.0) for t in range(10)]
+    assert counts_a == counts_b  # seeded replay
+    # Fractional carry: the total tracks the integral despite jitter.
+    assert sum(counts_a) == pytest.approx(50, abs=50 * 0.35)
+
+
+def test_arrival_sampler_wraps_periodic_curves():
+    from doorman_tpu.loadtest.ratecurve import ArrivalSampler, RateCurve
+
+    curve = RateCurve.parse("0:0,5:10,10:0")
+    s = ArrivalSampler(curve, jitter=0.0, rng=random.Random(0),
+                       period=10.0)
+    first = [s.take(t, t + 1.0) for t in range(10)]
+    second = [s.take(10 + t, 11 + t) for t in range(10)]
+    assert sum(first) == sum(second)  # one full period each
+
+
+def test_storm_parser_accepts_rate_curve_flags():
+    from doorman_tpu.loadtest.storm import make_parser
+
+    args = make_parser().parse_args([
+        "--server", "x:1", "--rate-curve", "0:10,30:45", "--rate-jitter",
+        "0.1", "--seed", "3",
+    ])
+    assert args.rate_curve == "0:10,30:45"
+    assert args.rate_jitter == 0.1
+    assert args.seed == 3
+
+
+def test_storm_rejects_rate_curve_with_streams():
+    from doorman_tpu.loadtest.storm import run_storm
+
+    with pytest.raises(ValueError, match="stream"):
+        asyncio.run(run_storm(
+            "127.0.0.1:1", workers=1, duration=0.1, stream=True,
+            rate_curve="0:10,1:10",
+        ))
