@@ -456,7 +456,8 @@ def bench_server_tick() -> None:
 
     def run(fused: bool, scoped: bool = False,
             churn_res: int = CHURN_RESOURCES,
-            lane: "tuple | None" = None) -> dict:
+            lane: "tuple | None" = None,
+            audit: int = 0) -> dict:
         """One full build + warmup + measured window; a fresh engine
         and rng per variant, so every path starts from byte-identical
         stores and replays the same-seeded churn stream. `fused` turns
@@ -468,7 +469,11 @@ def bench_server_tick() -> None:
         `lane` = (wire kind, variant|None) pins EVERY resource to one
         algorithm lane (the fairness-portfolio rows; the rng still
         draws the kind vector so the demand stream stays identical to
-        the mixed runs)."""
+        the mixed runs). `audit` > 0 attaches a production-shaped
+        ShadowAuditor (obs/audit.py) sampling every `audit` ticks:
+        the hot-path snapshot cost lands inside the measured tick
+        wall exactly as server.py's _audit_step pays it, the oracle
+        replay rides the audit executor off-thread."""
         rng = np.random.default_rng(11)
         engine = native.StoreEngine()
         kind_choices = np.array(
@@ -601,6 +606,14 @@ def bench_server_tick() -> None:
 
         from doorman_tpu.utils import dispatch as dispatch_mod
 
+        auditor = None
+        res_map = {}
+        if audit:
+            from doorman_tpu.obs.audit import ShadowAuditor
+
+            auditor = ShadowAuditor(sample=audit, inline=False)
+            res_map = {res.id: res for res in resources}
+
         tick_ms = []
         tick_only_ms = []
         churn_ms = []
@@ -633,6 +646,11 @@ def bench_server_tick() -> None:
                 full_ticks += 1
             if len(handles) >= PIPELINE_DEPTH_SERVER:
                 solver.collect(handles.pop(0))
+            if auditor is not None:
+                # The hot-path half of the audit (predicate + host
+                # snapshot) on the measured clock, as the server's
+                # tick loop pays it; the compare is off-thread.
+                auditor.maybe_sample(t, None, res_map)
             t2 = time.perf_counter()
             churn_ms.append((t1 - t0) * 1000.0)
             tick_ms.append((t2 - t0) * 1000.0)
@@ -646,6 +664,11 @@ def bench_server_tick() -> None:
         for h in handles:
             solver.collect(h)
         drain_ms = (time.perf_counter() - t0) * 1000.0
+        audit_stats = None
+        if auditor is not None:
+            auditor.drain()
+            auditor.close()
+            audit_stats = auditor.status()
         # Per-tick device-dispatch accounting over the measured window
         # (the same counters the flight recorder stamps per server
         # tick): the fused-vs-round-trip launch-tax reduction as a
@@ -688,6 +711,7 @@ def bench_server_tick() -> None:
             "host_syncs_per_tick": round(
                 dispatch_delta["host_syncs"] / TICKS_SERVER, 3
             ),
+            "audit": audit_stats,
         }
 
     # Round-trip variant first (metric name + semantics unchanged
@@ -1037,6 +1061,58 @@ def bench_server_tick() -> None:
         ),
         "ratio_vs_mixed_headline": prop_ratio,
         "slo": ca_verdicts,
+    })
+
+    # ---- shadow-audit overhead: the headline scoped config re-run
+    # with a production-shaped ShadowAuditor sampling every 17 ticks
+    # (coprime with the 16-tick rotation cadence, so samples never
+    # alias the delivery slice). The hot-path cost — the fixpoint
+    # predicate plus the host-side snapshot of every resource's solve
+    # inputs — lands inside the measured tick wall exactly as
+    # server.py's _audit_step pays it; the numpy-oracle replay rides
+    # the audit executor. The gate: the audited median tick must stay
+    # within 5% of the unaudited headline tier.
+    audited = run(
+        fused=True, scoped=True, churn_res=headline_churn_res,
+        audit=SERVER_ROTATE_TICKS + 1,
+    )
+    audited_med = float(np.median(audited["timed"]))
+    base_med = float(np.median(tiers[headline_frac]["timed"]))
+    audit_ratio = round(audited_med / max(base_med, 1e-9), 3)
+    audit_mean_ratio = round(
+        float(np.mean(audited["timed"]))
+        / max(float(np.mean(tiers[headline_frac]["timed"])), 1e-9),
+        3,
+    )
+    audit_specs = [
+        slo_mod.SloSpec(
+            name="server_tick_audit:overhead",
+            kind="max", target=1.05, unit="ratio",
+            source={"type": "scalar", "key": "audit_ratio"},
+            description=(
+                "audited scoped headline median tick vs the unaudited "
+                "tier — continuous shadow-oracle auditing must cost "
+                "the steady-state tick <= 5%"
+            ),
+        ),
+    ]
+    audit_verdicts = slo_mod.SloEngine(audit_specs).evaluate(
+        slo_mod.SloInputs(scalars={"audit_ratio": audit_ratio})
+    )
+    emit({
+        "metric": "server_tick_1m_leases_audit_overhead",
+        "value": audit_ratio,
+        "unit": "ratio",
+        "audited_wall_ms": round(audited_med, 3),
+        "baseline_wall_ms": round(base_med, 3),
+        "mean_ratio": audit_mean_ratio,
+        "audit_sample_ticks": SERVER_ROTATE_TICKS + 1,
+        "audit_samples": audited["audit"]["samples"],
+        "audit_compared_resources": audited["audit"][
+            "compared_resources"
+        ],
+        "audit_divergences": audited["audit"]["divergences"],
+        "slo": audit_verdicts,
     })
 
     # The scoped steady-state tick is the round's HEADLINE (the LAST

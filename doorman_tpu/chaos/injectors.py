@@ -313,7 +313,10 @@ class SolverInjector:
     doorman code modified): solver_error makes the device solve raise
     (tunnel down), solver_slow stretches it, resident_overflow raises
     ResidentOverflow from the resident step — exercising the server's
-    fallback-to-BatchSolver path and the handle-clearing fix."""
+    fallback-to-BatchSolver path and the handle-clearing fix — and
+    grant_corrupt scales one row of the solve's output (a silent wrong
+    answer, not a crash: the fault only the shadow-oracle audit can
+    see)."""
 
     def __init__(self, state: FaultState, target: str):
         self._state = state
@@ -330,6 +333,22 @@ class SolverInjector:
                 f"chaos: device backend unreachable ({self.target})"
             )
 
+    def _corrupt(self, gets):
+        """While grant_corrupt is active, scale gets[row] by `factor`
+        (default 0.75 — shrinking keeps capacity conservation and
+        has <= wants intact, so the corruption passes every structural
+        invariant and only the bit-identity audit can catch it)."""
+        p = self._state.active("grant_corrupt", self.target)
+        if p is None:
+            return gets
+        import numpy as np
+
+        out = np.asarray(gets).copy()
+        row = int(p.get("row", 0))
+        if 0 <= row < out.shape[0]:
+            out[row] = out[row] * float(p.get("factor", 0.75))
+        return out
+
     def install(self, server) -> None:
         injector = self
         orig_get_solver = server._get_solver
@@ -341,7 +360,7 @@ class SolverInjector:
 
                 def solve(snap):
                     injector._gate()
-                    return orig_solve(snap)
+                    return injector._corrupt(orig_solve(snap))
 
                 solver.solve = solve
                 solver._chaos_wrapped = True

@@ -42,6 +42,9 @@ KINDS = frozenset(
         "solver_error",     # device solve raises (tunnel down)
         "solver_slow",      # params: {"seconds": s} per solve
         "resident_overflow",# params: {"calls": n} ResidentOverflow per step
+        "grant_corrupt",    # silently scale one row of the solve's
+                            # grants; params: {"row": i, "factor": f}
+                            # — the shadow auditor's prey
         # host seam
         "port_bind",        # action: bind a loopback port (stale server)
         "backend_probe_fail",  # utils.backend probe argv fails

@@ -92,7 +92,10 @@ def device_tunnel_outage() -> FaultPlan:
     mid-rotation for three ticks (tick errors, not crashes — stores
     keep serving last solved grants), then a ResidentOverflow forces
     the BatchSolver fallback, then one slow solve. Allocation never
-    deviates from baseline."""
+    deviates from baseline. The shadow audit rides along as the CLEAN
+    pin: across tick errors, the fallback and the slow solve, the
+    sampled oracle replay must report ZERO divergences (the
+    grant_corruption plan is the dirty twin that must report some)."""
     return FaultPlan(
         name="device_tunnel_outage",
         seed=3,
@@ -107,6 +110,7 @@ def device_tunnel_outage() -> FaultPlan:
             "refresh_interval": 1,
             "learning_mode_duration": 0,
             "election_ttl": 3.0,
+            "audit_sample": 3,
         },
         events=[
             FaultEvent(at_tick=7, kind="solver_error", target="s0",
@@ -308,6 +312,58 @@ def shard_partition() -> FaultPlan:
     )
 
 
+def grant_corruption() -> FaultPlan:
+    """The shadow-oracle audit's proving ground: a batch server under
+    steady overload (FAIR_SHARE, wants 110 vs capacity 100, so the
+    waterfill output is constant) has one row of its solve output
+    silently scaled by 0.75 for nine ticks. The corruption shrinks a
+    grant, so every structural invariant (capacity conservation,
+    has <= wants, band floors) still passes — only the bit-identity
+    audit can see it. With the auditor sampling every 3 ticks inline,
+    the corrupted store value is constant across consecutive samples,
+    the two-strike identical-digest rule confirms at the second sample,
+    and the divergence lands within 2K ticks of the fault —
+    deterministically, byte-stable across replays. After heal the
+    solve output reverts and allocation reconverges within budget; the
+    verdict's audit block pins the divergence count and the offending
+    resource."""
+    return FaultPlan(
+        name="grant_corruption",
+        seed=11,
+        setup={
+            "servers": 1,
+            "clients": 3,
+            "wants": [20.0, 30.0, 60.0],
+            "capacity": 100,
+            # Has-independent lane: under constant overload the
+            # waterfill's output never moves, so the corrupted store
+            # value is digest-stable across audit samples.
+            "algorithm": "FAIR_SHARE",
+            # Python-store batch path: prepare -> solve -> apply over
+            # every resource each tick, so the corrupted solve output
+            # lands in the store the same tick (no delivery lag to
+            # reason about) and the audit sees it immediately.
+            "mode": "batch",
+            "native_store": False,
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+            # Shadow audit every 3 ticks, comparisons inline so the
+            # event log is byte-stable.
+            "audit_sample": 3,
+        },
+        events=[
+            FaultEvent(at_tick=10, kind="grant_corrupt", target="s0",
+                       duration_ticks=9,
+                       params={"row": 0, "factor": 0.75}),
+        ],
+        warmup_ticks=8,
+        total_ticks=32,
+        reconverge_ticks=8,
+    )
+
+
 def _warm_variant(name, algorithm, variant):
     def build():
         return master_flap_warm(
@@ -337,6 +393,7 @@ PLANS: Dict[str, "callable"] = {
     ),
     "client_storm": client_storm,
     "etcd_brownout": etcd_brownout,
+    "grant_corruption": grant_corruption,
     "device_tunnel_outage": device_tunnel_outage,
     "intermediate_partition": intermediate_partition,
     "shard_partition": shard_partition,

@@ -42,7 +42,9 @@ from doorman_tpu.client.connection import Connection
 from doorman_tpu.obs import metrics as metrics_mod
 from doorman_tpu.obs import slo as slo_mod
 from doorman_tpu.obs import trace as trace_mod
+from doorman_tpu.obs.detect import AnomalyDetector
 from doorman_tpu.obs.flightrec import FlightRecorder, store_digest
+from doorman_tpu.obs.history import HistoryStore
 from doorman_tpu.server.config import parse_yaml_config
 from doorman_tpu.server.election import (
     Election,
@@ -207,6 +209,18 @@ class ChaosRunner:
             clock=self.clock,
         )
         self.flight_dump: Optional[dict] = None
+        # The same per-tick records flow into an in-memory history
+        # store (no directory: ring + decimated tiers only), so the
+        # verdict can carry the anomaly detector's windowed view of
+        # the run — deterministic, because the records are.
+        self.history = HistoryStore(
+            ring=plan.total_ticks + 8,
+            component=f"chaos:{plan.name}",
+            clock=self.clock,
+        )
+        # Last shadow-audit divergence count seen per server, for
+        # event-log deltas the tick they fire.
+        self._audit_last: Dict[str, int] = {}
         # Fault / violation tallies in the default registry, so a chaos
         # run's damage shows on the same /metrics surface as everything
         # else (and soaks can assert on them).
@@ -325,6 +339,12 @@ class ChaosRunner:
                 # (the runner drives the fanout beat explicitly).
                 stream_push=bool(s.get("streams")),
                 shard=i if fed else None,
+                # Shadow audit (setup["audit_sample"]): comparisons run
+                # INLINE on the virtual clock so divergence counts land
+                # on deterministic ticks and the verdict stays
+                # byte-stable across replays.
+                audit_sample=int(s.get("audit_sample", 0)),
+                audit_inline=True,
             )
             SolverInjector(self.state, name).install(server)
             await server.start(0, host="127.0.0.1")
@@ -676,9 +696,17 @@ class ChaosRunner:
             }
         if persist_seq:
             rec["persist_seq"] = persist_seq
+        audited = [
+            server.shadow_audit
+            for _, server in sorted(self.servers.items())
+            if getattr(server, "shadow_audit", None) is not None
+        ]
+        if audited:
+            rec["audit_divergence"] = sum(a.divergences for a in audited)
         if violations:
             rec["violations"] = [v.as_log() for v in violations]
         self.flightrec.record(**rec)
+        self.history.append(dict(rec))
         if violations and self.flight_dump is None:
             self.flight_dump = self.flightrec.dump(
                 f"invariant:{violations[0].invariant}"
@@ -733,6 +761,28 @@ class ChaosRunner:
             "ok": all(v["status"] != "fail" for v in verdicts),
             "verdicts": verdicts,
         }
+
+    def _detect_block(self) -> Optional[dict]:
+        """Replay the run's history records through the anomaly
+        detector: a zero floor on the audit-divergence count (any
+        nonzero value is anomalous, no warmup needed) plus a robust-z
+        watch on each admission controller's level. Pure sorted-list
+        arithmetic over deterministic records, so the block is
+        byte-stable across replays. None when the plan arms neither
+        the auditor nor admission."""
+        recs = self.history.records()
+        fields: List[str] = []
+        if any("audit_divergence" in r for r in recs):
+            fields.append("audit_divergence")
+        adm_servers = sorted(
+            {n for r in recs for n in r.get("admission", {})}
+        )
+        fields.extend(f"admission.{n}.level" for n in adm_servers)
+        if not fields:
+            return None
+        return AnomalyDetector.scan_records(
+            recs, tuple(fields), floors={"audit_divergence": 0.0}
+        )
 
     def _snapshot(self) -> Dict[str, float]:
         return {
@@ -805,6 +855,21 @@ class ChaosRunner:
                             self.log.append(
                                 [tick, "tick_error", name, str(e)]
                             )
+
+                # Shadow-audit deltas land in the event log the tick
+                # they fire (chaos auditors run inline, so counts are
+                # current once tick_once returns): seeded replays pin
+                # WHEN the auditor caught the corruption.
+                for name, server in sorted(self.servers.items()):
+                    aud = getattr(server, "shadow_audit", None)
+                    if aud is None:
+                        continue
+                    if aud.divergences != self._audit_last.get(name, 0):
+                        self._audit_last[name] = aud.divergences
+                        self.log.append(
+                            [tick, "audit_divergence", name,
+                             aud.divergences]
+                        )
 
                 for client in self.clients:
                     await client.refresh_once()
@@ -912,6 +977,19 @@ class ChaosRunner:
             ),
             "violations": [v.as_log() for v in self.violations],
             "admission": admission_tallies,
+            # Shadow-audit outcome per audited server (None when the
+            # plan doesn't arm the auditor): sample/divergence counts
+            # and the bounded detail rows, byte-stable because chaos
+            # auditors compare inline on virtual time.
+            "audit": {
+                name: server.shadow_audit.status()
+                for name, server in sorted(self.servers.items())
+                if getattr(server, "shadow_audit", None) is not None
+            } or None,
+            # The anomaly detector's windowed verdict over the run's
+            # history records (None when there is nothing to watch).
+            "detect": self._detect_block(),
+            "history": self.history.status(),
             # Machine-readable SLO verdicts (reconvergence budget,
             # top-band goodput floor with per-band tallies), each with
             # its delta vs the last round that embedded the same verdict.

@@ -48,6 +48,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "violation-triggered dump when one fired, else "
                         "an on-demand dump of the full ring) to this "
                         "path, plus a Chrome-trace overlay beside it")
+    p.add_argument("--history-dir", default="",
+                   help="persist the run's per-tick history records as "
+                        "durable segments here (queryable afterwards "
+                        "with `python -m doorman_tpu.cmd.obs`; CI "
+                        "uploads these as failure artifacts)")
     return p
 
 
@@ -94,6 +99,26 @@ async def run(args: argparse.Namespace) -> int:
             f"(overlay: {overlay_path})",
             file=sys.stderr,
         )
+    if args.history_dir:
+        # Re-home the runner's in-memory history as durable segments:
+        # each record re-stamps its hseq/run in the target store, so a
+        # directory accumulating several runs keeps them distinguishable
+        # (cmd.obs `delta` compares across them).
+        from doorman_tpu.obs.history import HistoryStore
+
+        os.makedirs(args.history_dir, exist_ok=True)
+        store = HistoryStore(
+            args.history_dir,
+            ring=plan.total_ticks + 8,
+            component=f"chaos:{plan.name}",
+        )
+        try:
+            for rec in runner.history.records():
+                store.append(rec)
+        finally:
+            store.close()
+        print(f"wrote history segments to {args.history_dir}",
+              file=sys.stderr)
     if args.trace:
         from doorman_tpu.chaos.trace_export import write_chrome_trace
 

@@ -94,6 +94,35 @@ def make_parser() -> argparse.ArgumentParser:
                         "(JSON + Chrome-trace overlay per dump); "
                         "defaults to $DOORMAN_FLIGHTREC_DIR, empty "
                         "keeps dumps in-memory only")
+    p.add_argument("--history-dir", default="",
+                   help="durable flight-record history: per-tick "
+                        "records append to checksummed segment files "
+                        "in this directory (torn-tail tolerant) and "
+                        "are replayed at startup, so SLO windows and "
+                        "trajectory deltas span restarts; served at "
+                        "/debug/history and queryable offline with "
+                        "python -m doorman_tpu.cmd.obs (empty "
+                        "disables)")
+    p.add_argument("--history-buffer", type=int, default=4096,
+                   help="history raw-ring capacity (decimated tiers "
+                        "extend past it at bounded memory)")
+    p.add_argument("--audit-sample", type=int, default=0,
+                   help="shadow-oracle audit: every K ticks (and on "
+                        "every solve_mode transition) replay each "
+                        "store's staged inputs through the numpy host "
+                        "oracles off the hot path and compare grants "
+                        "bit-exactly (few-ulp for iterative lanes); a "
+                        "two-strike-confirmed divergence raises the "
+                        "doorman_audit_divergence counter, a flight-"
+                        "recorder error + auto-dump, and a standing "
+                        "failing SLO gate (0 disables)")
+    p.add_argument("--detect", action="store_true",
+                   help="online anomaly detection over the per-tick "
+                        "record streams (tick wall ms, dispatch "
+                        "accounting, scoped rows, admission level) "
+                        "with EWMA + MAD robust z-scores; detections "
+                        "land as detect.anomaly trace instants, "
+                        "chrome-overlay tracks and an SLO verdict")
     p.add_argument("--persist", default="",
                    help="durable lease-state snapshots + journal for "
                         "warm master takeover: 'file:<dir>' (shared "
@@ -353,7 +382,17 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
         max_streams_per_band=args.max_streams_per_band,
         stream_shards=args.stream_shards,
         shard=shard,
+        history_dir=args.history_dir or None,
+        history_capacity=args.history_buffer,
+        audit_sample=args.audit_sample,
+        detect=args.detect,
     )
+    if args.history_dir:
+        log.info("durable history in %s (run %d, replayed %d records)",
+                 args.history_dir, server.history.run,
+                 len(server.history.records()))
+    if args.audit_sample:
+        log.info("shadow-oracle audit every %d ticks", args.audit_sample)
 
     port = await server.start(
         args.port,

@@ -47,6 +47,10 @@ ROUTES = (
     ("/debug/flightrec", "per-tick flight recorder: ring summary; "
                          "?format=json dumps the last N tick records, "
                          "?format=chrome the overlay trace"),
+    ("/debug/history", "durable flight-record history: run/occupancy "
+                       "summary; ?format=json dumps records "
+                       "(&tier=F for a decimated tier, &start=/&end= "
+                       "by hseq), ?format=chrome the overlay trace"),
     ("/debug/vars", "expvar-style JSON snapshot"),
     ("/metrics", "Prometheus text exposition"),
     ("/healthz", "liveness probe"),
@@ -535,6 +539,77 @@ class DebugServer:
             title="/debug/flightrec", body="".join(sections)
         )
 
+    def _history_views(
+        self,
+        start: Optional[int] = None,
+        end: Optional[int] = None,
+        tier: int = 0,
+    ) -> Dict[str, Optional[dict]]:
+        """server id -> history view (records by hseq range/tier).
+        The store is thread-safe, so no loop hop is needed."""
+        out: Dict[str, Optional[dict]] = {}
+        for server, _loop in self._servers:
+            hs = getattr(server, "history", None)
+            out[getattr(server, "id", "?")] = (
+                hs.view(start=start, end=end, tier=tier)
+                if hs is not None
+                else None
+            )
+        return out
+
+    def _history_chrome(self) -> str:
+        """Overlay trace of the first server with a history store."""
+        for server, _loop in self._servers:
+            hs = getattr(server, "history", None)
+            if hs is not None:
+                return hs.chrome()
+        return json.dumps({"traceEvents": []})
+
+    def _history_page(self) -> str:
+        sections = []
+        for server, _loop in self._servers:
+            hs = getattr(server, "history", None)
+            sid = getattr(server, "id", "?")
+            if hs is None:
+                sections.append(
+                    f"<h2>server {html.escape(sid)}</h2>"
+                    "<p>history disabled (--history-dir)</p>"
+                )
+                continue
+            st = hs.status()
+            tier_txt = ", ".join(
+                f"x{f}: {n} buckets" for f, n in sorted(
+                    st["tiers"].items(), key=lambda kv: int(kv[0])
+                )
+            )
+            recent = hs.records()[-5:]
+            recent_rows = "".join(
+                f"<tr><td>{r.get('hseq')}</td><td>{r.get('run')}</td>"
+                f"<td>{r.get('tick', '-')}</td>"
+                f"<td>{r.get('wall_ms', '-')}</td>"
+                f"<td>{html.escape(str(r.get('solve_mode', '-')))}</td>"
+                f"<td>{r.get('audit_divergence', '-')}</td></tr>"
+                for r in recent
+            )
+            sections.append(
+                f"<h2>server {html.escape(sid)}</h2>"
+                f"<p>run: {st['run']} | head hseq: {st['head_hseq']} | "
+                f"ring: {st['ring']}/{st['ring_capacity']} | segments: "
+                f"{st['segments']} ({html.escape(str(st['dir']))}) | "
+                f"tiers: {html.escape(tier_txt or '(none)')}</p>"
+                "<table><tr><th>hseq</th><th>run</th><th>tick</th>"
+                "<th>wall ms</th><th>solve mode</th>"
+                f"<th>audit div</th></tr>{recent_rows}</table>"
+                "<p><a href='/debug/history?format=json'>dump JSON</a>"
+                " | <a href='/debug/history?format=chrome'>overlay "
+                "trace</a></p>"
+            )
+        if not sections:
+            sections.append("<p>no servers</p>")
+        return _PAGE.format(
+            title="/debug/history", body="".join(sections)
+        )
+
     def _resources_page(self, only: Optional[str]) -> str:
         sections = []
         for (server, loop), st in zip(self._servers, self._statuses()):
@@ -662,6 +737,38 @@ class DebugServer:
                         else:
                             body, ctype = (
                                 debug._flightrec_page(),
+                                "text/html",
+                            )
+                    elif url.path == "/debug/history":
+                        q = parse_qs(url.query)
+                        fmt = q.get("format", [""])[0]
+                        if fmt == "json":
+
+                            def _int(key):
+                                try:
+                                    return int(q[key][0])
+                                except (KeyError, ValueError):
+                                    return None
+
+                            body, ctype = (
+                                json.dumps(
+                                    debug._history_views(
+                                        start=_int("start"),
+                                        end=_int("end"),
+                                        tier=_int("tier") or 0,
+                                    ),
+                                    indent=1, default=str,
+                                ),
+                                "application/json",
+                            )
+                        elif fmt == "chrome":
+                            body, ctype = (
+                                debug._history_chrome(),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = (
+                                debug._history_page(),
                                 "text/html",
                             )
                     elif url.path == "/debug/requests":

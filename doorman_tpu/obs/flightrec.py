@@ -254,12 +254,18 @@ class FlightRecorder:
             # forecaster's next-tick demand prediction — overlaying
             # forecast_rps on offered shows the predictive-admission
             # lead time directly.
+            # audit_divergence / anomalies are the continuous-telemetry
+            # beat (obs/audit.py, obs/detect.py): cumulative confirmed
+            # shadow-oracle divergences and online anomaly detections —
+            # both flatline at zero on a healthy server, so any step in
+            # these tracks is the moment to scrub to.
             for counter in ("admission_level", "persist_seq",
                             "straddle_capacity", "straddle_updates",
                             "upstream_rpcs", "dispatches",
                             "host_syncs", "scoped_rows",
                             "scoped_resources", "population",
-                            "offered", "forecast_rps"):
+                            "offered", "forecast_rps",
+                            "audit_divergence", "anomalies"):
                 v = rec.get(counter)
                 if isinstance(v, (int, float)):
                     events.append({

@@ -47,7 +47,9 @@ __all__ = [
     "SloInputs",
     "SloSpec",
     "TrajectoryComparator",
+    "audit_divergence_spec",
     "bench_verdict",
+    "detector_anomaly_spec",
     "histogram_quantile",
     "predictive_goodput_verdict",
     "reconvergence_spec",
@@ -336,6 +338,44 @@ def server_slos(
             ),
         ),
     ]
+
+
+def audit_divergence_spec(name: str = "audit_divergence") -> SloSpec:
+    """The standing shadow-oracle audit gate: the sampled fixpoint
+    replay of every store through the numpy host oracles
+    (obs/audit.py) must have found ZERO divergences. Any nonzero count
+    is a live bit-identity violation — the production form of the
+    per-lane parity pins."""
+    return SloSpec(
+        name=name,
+        kind="max",
+        target=0.0,
+        source={"type": "scalar", "key": "audit_divergence"},
+        unit="divergences",
+        description=(
+            "shadow-oracle audit divergences (store of record vs numpy "
+            "oracle fixpoint, two-strike confirmed) — must stay zero"
+        ),
+    )
+
+
+def detector_anomaly_spec(
+    target: float = 0.0, name: str = "detector_anomalies"
+) -> SloSpec:
+    """The online anomaly detector's gate (obs/detect.py): robust-z /
+    pinned-floor detections over the watched history streams. Default
+    target zero — a steady run should not trip the detector."""
+    return SloSpec(
+        name=name,
+        kind="max",
+        target=float(target),
+        source={"type": "scalar", "key": "detector_anomalies"},
+        unit="detections",
+        description=(
+            "EWMA+MAD robust-z and pinned-floor detections over the "
+            "flight-record history streams"
+        ),
+    )
 
 
 def bench_verdict(row: dict) -> Optional[dict]:

@@ -105,6 +105,11 @@ KNOWN_INSTANT_NAMES = frozenset({
     # workload.crowd_start, workload.deploy, workload.elastic_preempt,
     # ... (harness.note stamps workload.<kind>).
     "workload.*",
+    # Continuous telemetry (obs/audit.py, obs/detect.py): a confirmed
+    # shadow-oracle divergence and an online anomaly detection, both
+    # stamped by the server's tick loop off the hot path.
+    "audit.divergence",
+    "detect.anomaly",
 })
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must

@@ -116,6 +116,11 @@ class CapacityServer(CapacityServicer):
         max_streams_per_band: int = 0,
         stream_shards: int = 1,
         shard: Optional[int] = None,
+        history_dir: Optional[str] = None,
+        history_capacity: int = 4096,
+        audit_sample: int = 0,
+        audit_inline: bool = False,
+        detect: bool = False,
     ):
         if mode not in ("immediate", "batch"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -317,6 +322,38 @@ class CapacityServer(CapacityServicer):
             )
         else:
             self.flightrec = None
+        # Continuous telemetry (obs.history / obs.audit / obs.detect),
+        # all off by default. History makes the flight-record stream
+        # durable and restart-spanning; the shadow auditor replays
+        # every store through the numpy host oracles every
+        # `audit_sample` ticks (and on solve_mode transitions) off the
+        # hot path; the detector scores each tick record's watched
+        # streams with robust z / pinned floors.
+        self.history = None
+        if history_dir is not None:
+            from doorman_tpu.obs.history import HistoryStore
+
+            self.history = HistoryStore(
+                history_dir,
+                ring=history_capacity,
+                component=f"server:{server_id}",
+                clock=clock,
+            )
+        self.shadow_audit = None
+        if audit_sample > 0:
+            from doorman_tpu.obs.audit import ShadowAuditor
+
+            self.shadow_audit = ShadowAuditor(
+                sample=audit_sample,
+                inline=audit_inline,
+                on_divergence=self._on_audit_divergence,
+                clock=clock,
+            )
+        self.detector = None
+        if detect:
+            from doorman_tpu.obs.detect import AnomalyDetector
+
+            self.detector = AnomalyDetector()
         self._flight_phase_prev: Dict[str, float] = {}
         self._flight_fed_prev: Dict[str, float] = {}
         # Dispatch accounting baseline (utils.dispatch is process-
@@ -409,6 +446,10 @@ class CapacityServer(CapacityServicer):
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks.clear()
+        if self.shadow_audit is not None:
+            self.shadow_audit.close()
+        if self.history is not None:
+            self.history.close()
         await self.election.stop()
         if self._parent_conn is not None:
             await self._parent_conn.close()
@@ -849,6 +890,11 @@ class CapacityServer(CapacityServicer):
                 # tick_error entry).
                 self._flight_abort(tick_start, exc)
                 raise
+            # Shadow audit BEFORE the flight record so an inline
+            # auditor's fresh divergence count rides this very tick's
+            # record (the executor-backed live default may lag a tick
+            # — the counter is cumulative either way).
+            self._audit_step()
             self._flight_record_tick(tick_start)
             if self._admission is not None:
                 # Tick lag feeds the overload controller: a solve
@@ -1227,13 +1273,91 @@ class CapacityServer(CapacityServicer):
     # Flight recorder + SLO evaluation
     # ------------------------------------------------------------------
 
+    def _solve_mode(self) -> Optional[str]:
+        """The last tick's solve mode across the active resident paths
+        ("scoped", "full", or "full:<reason>"; None before any resident
+        tick) — shared by the flight record and the audit sampler's
+        transition trigger."""
+        solvers = [
+            s
+            for s in (self._resident, self._resident_wide)
+            if s is not None and s.ticks
+        ]
+        if not solvers:
+            return None
+        forced = [
+            s.last_full_reason
+            for s in solvers
+            if s.last_solve_mode == "full" and s.last_full_reason
+        ]
+        if forced:
+            return f"full:{forced[0]}"
+        if any(s.last_solve_mode == "full" for s in solvers):
+            return "full"
+        return "scoped"
+
+    def _audit_step(self) -> None:
+        """Per-tick shadow-audit hook (tick lock held): a cheap
+        predicate, a host-side snapshot when a sample is due, and the
+        oracle replay on the audit executor (inline for chaos). Never
+        raises — the auditor observes the plane, it must not fly it."""
+        aud = self.shadow_audit
+        if aud is None or not self.resources:
+            return
+        try:
+            aud.maybe_sample(
+                self._ticks_done, self._solve_mode(), self.resources
+            )
+        except Exception:
+            log.exception("%s: shadow audit sampling failed", self.id)
+
+    def _on_audit_divergence(self, detail: dict) -> None:
+        """A confirmed divergence's blast pattern: the counter, the
+        trace instant, a flight-recorder error record, and an
+        auto-dump. Runs on the audit executor (or the tick loop when
+        inline); everything touched here is thread-safe."""
+        metrics_mod.default_registry().counter(
+            "doorman_audit_divergence",
+            "Confirmed shadow-oracle audit divergences (store of "
+            "record vs numpy oracle fixpoint).",
+            labels=("server", "resource"),
+        ).inc(self.id, str(detail.get("rid", "?")))
+        trace_mod.default_tracer().instant(
+            "audit.divergence", cat="audit",
+            args={
+                "server": self.id,
+                "resource": detail.get("rid"),
+                "digest": detail.get("digest"),
+            },
+        )
+        fr = self.flightrec
+        if fr is not None:
+            try:
+                fr.record(
+                    t=self._clock(),
+                    tick=detail.get("tick"),
+                    is_master=self.is_master,
+                    epoch=self.mastership_epoch,
+                    error=(
+                        f"audit.divergence: {detail.get('rid')} store "
+                        f"{detail.get('has')} vs oracle "
+                        f"{detail.get('expected')}"
+                    ),
+                    audit=dict(detail),
+                )
+                fr.dump("audit_divergence")
+            except Exception:
+                log.exception(
+                    "%s: audit divergence dump failed", self.id
+                )
+
     def _flight_record_tick(self, tick_start: float) -> None:
         """One structured record per applied tick: wall time, per-phase
         lap deltas, admission level + per-band shed tallies, per-shard
         transfer bytes, persist journal seq, mastership epoch, and a
         store digest. O(#resources) — the stores keep running sums."""
         fr = self.flightrec
-        if fr is None:
+        if fr is None and self.history is None and self.detector is None:
             return
         from doorman_tpu.obs import phases as phases_mod
         from doorman_tpu.obs.flightrec import store_digest
@@ -1296,17 +1420,7 @@ class CapacityServer(CapacityServicer):
             if s is not None and s.ticks
         ]
         if solvers:
-            forced = [
-                s.last_full_reason
-                for s in solvers
-                if s.last_solve_mode == "full" and s.last_full_reason
-            ]
-            if forced:
-                rec["solve_mode"] = f"full:{forced[0]}"
-            elif any(s.last_solve_mode == "full" for s in solvers):
-                rec["solve_mode"] = "full"
-            else:
-                rec["solve_mode"] = "scoped"
+            rec["solve_mode"] = self._solve_mode()
             rec["scoped_rows"] = sum(
                 int(s.last_scope.get("rows", 0)) for s in solvers
             )
@@ -1372,7 +1486,30 @@ class CapacityServer(CapacityServicer):
             rec["shard_bytes"] = {
                 f"{c}/{d}": list(v) for (c, d), v in sorted(shards.items())
             }
-        fr.record(**rec)
+        if self.shadow_audit is not None:
+            # Cumulative confirmed divergences: a chrome-overlay track
+            # that flatlines at zero on a healthy server.
+            rec["audit_divergence"] = self.shadow_audit.divergences
+        if self.detector is not None:
+            try:
+                detections = self.detector.observe(rec)
+            except Exception:
+                detections = []
+                log.exception("%s: anomaly detector failed", self.id)
+            rec["anomalies"] = self.detector.anomalies
+            if detections:
+                rec["anomaly_detections"] = detections
+                for det in detections:
+                    trace_mod.default_tracer().instant(
+                        "detect.anomaly", cat="detect",
+                        args={"server": self.id, **det},
+                    )
+        if fr is not None:
+            fr.record(**rec)
+        if self.history is not None:
+            # History gets its own copy: the recorder mutates its dict
+            # (seq stamp) and history stamps hseq/run on this one.
+            self.history.append(dict(rec))
 
     def _flight_abort(self, tick_start: float, exc: BaseException) -> None:
         """Record the failed tick and auto-dump the ring. Must never
@@ -1404,14 +1541,21 @@ class CapacityServer(CapacityServicer):
         from doorman_tpu.obs import slo as slo_mod
 
         samples: Dict[str, list] = {}
-        if self.flightrec is not None:
+        if self.history is not None:
+            # The durable history ring spans process lifetimes (the
+            # previous run's records were replayed at open), so the
+            # tick-budget window survives a restart.
+            ticks = self.history.series("wall_ms")
+        elif self.flightrec is not None:
             ticks = [
                 r["wall_ms"]
                 for r in self.flightrec.snapshot()
                 if isinstance(r.get("wall_ms"), (int, float))
             ]
-            if ticks:
-                samples["tick_ms"] = ticks
+        else:
+            ticks = []
+        if ticks:
+            samples["tick_ms"] = ticks
         scalars: Dict[str, float] = {}
         if self.last_restore is not None and self.last_restore.get(
             "mode"
@@ -1424,13 +1568,26 @@ class CapacityServer(CapacityServicer):
             for (method, band), counts in self._admission.tallies.items():
                 if method == "GetCapacity":
                     band_tallies[int(band)] = dict(counts)
+        specs = slo_mod.server_slos()
+        if self.shadow_audit is not None:
+            # The standing audit gate: any confirmed shadow-oracle
+            # divergence fails the SLO block until the process is
+            # replaced — a live bit-identity violation is not a
+            # transient.
+            scalars["audit_divergence"] = float(
+                self.shadow_audit.divergences
+            )
+            specs.append(slo_mod.audit_divergence_spec())
+        if self.detector is not None:
+            scalars["detector_anomalies"] = float(self.detector.anomalies)
+            specs.append(slo_mod.detector_anomaly_spec())
         inputs = slo_mod.SloInputs(
             registry=registry or metrics_mod.default_registry(),
             samples=samples,
             scalars=scalars,
             band_tallies=band_tallies,
         )
-        verdicts = slo_mod.SloEngine(slo_mod.server_slos()).evaluate(inputs)
+        verdicts = slo_mod.SloEngine(specs).evaluate(inputs)
         self.last_slo = {
             "at": self._clock(),
             "ok": all(v["status"] != "fail" for v in verdicts),
@@ -2084,6 +2241,23 @@ class CapacityServer(CapacityServicer):
             "flightrec": (
                 self.flightrec.status()
                 if self.flightrec is not None
+                else None
+            ),
+            # Continuous telemetry (None: feature off): durable
+            # history, shadow-oracle audit, anomaly detector.
+            "history": (
+                self.history.status()
+                if self.history is not None
+                else None
+            ),
+            "shadow_audit": (
+                self.shadow_audit.status()
+                if self.shadow_audit is not None
+                else None
+            ),
+            "detector": (
+                self.detector.status()
+                if self.detector is not None
                 else None
             ),
             "slo": self.last_slo,

@@ -198,6 +198,43 @@ class SeasonalForecaster:
     def ticks_observed(self) -> int:
         return self._t
 
+    def warm_start(
+        self,
+        history,
+        *,
+        field: str = "offered",
+        tier: int = 0,
+        interval: float = 1.0,
+    ) -> int:
+        """Prime the model from a flight-record history before serving
+        live traffic: replay the per-tick offered-rate stream through
+        ``observe`` so a restarted process starts with the previous
+        run's level/seasonal state instead of a cold model.
+
+        ``history`` is an ``obs.history.HistoryStore`` (or any iterable
+        of record dicts / scalars, oldest first). Each record's
+        ``field`` value is divided by ``interval`` (counts -> rates,
+        same arithmetic as the live harness) and broadcast across the
+        batch. Because this IS ``observe``, the resulting state is
+        bit-identical to having watched the same stream live — the
+        restart-spanning twin of the oracle discipline. Returns the
+        number of ticks folded in."""
+        if hasattr(history, "records"):
+            records = history.records(tier=tier)
+        else:
+            records = history
+        fed = 0
+        for rec in records:
+            v = rec.get(field) if isinstance(rec, dict) else rec
+            if v is None:
+                continue
+            x = np.full(
+                self.series, np.float32(float(v) / interval), np.float32
+            )
+            self.observe(x)
+            fed += 1
+        return fed
+
     def observe(self, x: Sequence[float]) -> np.ndarray:
         """Fold in one tick's per-series rates; return float32[B]
         forecast for the next tick."""

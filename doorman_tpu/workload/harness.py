@@ -67,8 +67,23 @@ __all__ = ["WorkloadRunner", "run_spec"]
 
 
 class WorkloadRunner:
-    def __init__(self, spec: WorkloadSpec):
+    def __init__(self, spec: WorkloadSpec,
+                 forecaster: Optional[SeasonalForecaster] = None):
         self.spec = spec
+        # A caller-supplied (typically history-warm-started, see
+        # forecast.warm_start) forecaster to use instead of building a
+        # cold one from the predictive config; its series count must
+        # match the config's bands. Validated here, before run() has
+        # started any server a failure would leak.
+        self._preset_forecaster = forecaster
+        if forecaster is not None:
+            predictive = spec.predictive_config() or {}
+            bands = predictive.get("bands", [0, 1])
+            if forecaster.series != len(bands):
+                raise ValueError(
+                    f"preset forecaster has {forecaster.series} "
+                    f"series, predictive config has {len(bands)} bands"
+                )
         self.clock = ChaosClock()
         self.tick_interval = float(spec.tick_interval)
         # Fault-free switchboard: the workload harness injects load,
@@ -321,13 +336,25 @@ class WorkloadRunner:
             self._forecast_bands = [
                 int(b) for b in predictive.get("bands", [0, 1])
             ]
-            self.forecaster = SeasonalForecaster(
-                series=len(self._forecast_bands),
-                period=int(predictive["period"]),
-                alpha=float(predictive.get("alpha", 0.5)),
-                beta=float(predictive.get("beta", 0.25)),
-                engine=str(predictive.get("engine", "auto")),
-            )
+            if self._preset_forecaster is not None:
+                if self._preset_forecaster.series != len(
+                    self._forecast_bands
+                ):
+                    raise ValueError(
+                        f"preset forecaster has "
+                        f"{self._preset_forecaster.series} series, "
+                        f"predictive config has "
+                        f"{len(self._forecast_bands)} bands"
+                    )
+                self.forecaster = self._preset_forecaster
+            else:
+                self.forecaster = SeasonalForecaster(
+                    series=len(self._forecast_bands),
+                    period=int(predictive["period"]),
+                    alpha=float(predictive.get("alpha", 0.5)),
+                    beta=float(predictive.get("beta", 0.25)),
+                    engine=str(predictive.get("engine", "auto")),
+                )
         for g in self.generators:
             await g.setup(self)
 

@@ -136,3 +136,84 @@ if HAVE_HYPOTHESIS:
         for _ in range(ticks):
             out = f.observe(arr)
         np.testing.assert_array_equal(out, arr)
+
+
+# ----------------------------------------------------------------------
+# warm_start: restart-spanning bit identity
+# ----------------------------------------------------------------------
+
+
+def test_warm_start_is_bit_identical_to_online_feeding():
+    """Replaying a history through warm_start IS observe: the primed
+    model's state and next forecast match a never-restarted twin to
+    the bit."""
+    from doorman_tpu.obs.history import HistoryStore
+
+    hist = HistoryStore(ring=64, clock=lambda: 0.0)
+    offered = [0.0, 3.0, 17.0, 4.0, 9.0, 9.0, 2.0, 30.0]
+    for i, v in enumerate(offered):
+        hist.append({"tick": i, "offered": v})
+
+    warm = fc.SeasonalForecaster(series=2, period=4, engine="host")
+    fed = warm.warm_start(hist, interval=2.0)
+    assert fed == len(offered)
+    assert warm.ticks_observed == len(offered)
+
+    live = fc.SeasonalForecaster(series=2, period=4, engine="host")
+    for v in offered:
+        live.observe(np.full(2, np.float32(v / 2.0), np.float32))
+
+    for w, l in zip(warm._state, live._state):
+        np.testing.assert_array_equal(
+            np.asarray(w, np.float32).view(np.uint32),
+            np.asarray(l, np.float32).view(np.uint32),
+        )
+    nxt = np.asarray([5.0, 6.0], np.float32)
+    np.testing.assert_array_equal(
+        warm.observe(nxt).view(np.uint32),
+        live.observe(nxt).view(np.uint32),
+    )
+
+
+def test_warm_start_accepts_scalars_and_skips_missing_fields():
+    f = fc.SeasonalForecaster(series=1, period=2, engine="host")
+    fed = f.warm_start([1.0, {"offered": 2.0}, {"other": 9.0}, 3.0])
+    assert fed == 3  # the field-less dict is skipped, not an error
+    assert f.ticks_observed == 3
+
+
+def test_runner_takes_a_primed_forecaster():
+    """A history-primed forecaster rides the workload harness: the
+    runner uses it as-is, so its ticks_observed span the prior run
+    plus this one."""
+    import asyncio
+
+    from doorman_tpu.workload.harness import WorkloadRunner
+    from doorman_tpu.workload.spec import WorkloadSpec
+
+    def spec(seed=0):
+        return WorkloadSpec.make(
+            "t_warm", 12, seed=seed, capacity=100.0,
+            algorithm="PRIORITY_BANDS",
+            admission={"max_rps": 10.0},
+            base_clients=[(0, 10.0), (1, 10.0), (1, 10.0)],
+            predictive={"period": 4, "alpha": 0.25, "beta": 0.5},
+        )
+
+    preset = fc.SeasonalForecaster(
+        series=2, period=4, alpha=0.25, beta=0.5, engine="host"
+    )
+    warm_ticks = preset.warm_start([4.0] * 8)
+    runner = WorkloadRunner(spec(), forecaster=preset)
+    v = asyncio.run(runner.run())
+    assert runner.forecaster is preset
+    assert v["summary"]["forecaster"]["ticks_observed"] == (
+        warm_ticks + v["ticks"]
+    )
+
+    # A preset whose series count disagrees with the predictive
+    # config's bands is a config error at construction — before run()
+    # has started anything a failure would leak.
+    wrong = fc.SeasonalForecaster(series=3, period=4, engine="host")
+    with pytest.raises(ValueError, match="series"):
+        WorkloadRunner(spec(), forecaster=wrong)
