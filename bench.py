@@ -2260,6 +2260,373 @@ def bench_server_stream_fanout_scaling() -> None:
     asyncio.run(run())
 
 
+FRONTEND_WORKERS = 4
+FRONTEND_RING_BYTES = 1 << 22
+FRONTEND_EST_SECONDS = 5.0
+FRONTEND_HOLD_SECONDS = 90.0
+FRONTEND_HELD_TARGET = 1_000_000
+FRONTEND_EST_FLOOR = 50_000.0  # establishments/s through the ramp
+FRONTEND_PUSH_TICKS = 30
+FRONTEND_TICK_INTERVAL = 1.0
+FRONTEND_READY_SECONDS = 60.0
+
+
+def bench_server_frontend() -> None:
+    """Serving-plane pool rows: establishment storm through the
+    SO_REUSEPORT worker pool, and the held-stream ceiling with
+    client-observed push latency.
+
+    Both rows drive the REAL pool (spawned listener workers over
+    shared-memory rings, the Establish/Drop/Heartbeat control surface
+    — nothing inline): `server_frontend_establishment_storm` pushes
+    the multi-process storm driver's establishment burst through the
+    pool's forwarded gate and reports merged establishments/sec
+    (floor: 50k/s); `server_frontend_held_streams` parks a held-stream
+    population across the workers, then churns a sentinel stream's
+    resource across manual ticks and measures the client-observed push
+    latency (tick edge -> WatchCapacity message through the ring and
+    the holding worker), p99 held under one tick interval (floors: 1M
+    streams held, push p99 <= 1 tick).
+
+    Cores gate (the BENCH_r05 convention: a diagnostic, never a
+    metric row): the pool's workers, the tick process, and the storm
+    client processes only measure anything when they run CONCURRENTLY
+    — a single-core box timeslices them and would record meaningless
+    rates into the trajectory, so fewer than FRONTEND_WORKERS + 2
+    cores degrades BOTH rows to `frontend_requires_cores`."""
+    import asyncio
+    import os
+    import socket
+
+    cores = os.cpu_count() or 1
+    needed = FRONTEND_WORKERS + 2
+    if cores < needed:
+        diagnostic({
+            "diagnostic": "frontend_requires_cores",
+            "cpu_cores": cores,
+            "cores_needed": needed,
+            "rows": [
+                "server_frontend_establishment_storm",
+                "server_frontend_held_streams",
+            ],
+            "note": (
+                f"the serving-plane rows need {FRONTEND_WORKERS} "
+                "listener workers, the tick process, and the storm "
+                f"clients running concurrently ({needed} cores); only "
+                f"{cores} available — no metric row (remeasure on a "
+                "multi-core box)"
+            ),
+        })
+        return
+
+    from doorman_tpu.algorithms import Request as _Request
+    from doorman_tpu.loadtest.storm import percentile, run_storm_procs
+    from doorman_tpu.obs import slo as slo_mod
+    from doorman_tpu.proto import doorman_stream_pb2 as _spb
+    from doorman_tpu.server.config import parse_yaml_config
+    from doorman_tpu.server.election import TrivialElection
+    from doorman_tpu.server.server import CapacityServer
+
+    config = parse_yaml_config(
+        "resources:\n"
+        '- identifier_glob: "*"\n'
+        "  capacity: 600\n"
+        "  safe_capacity: 1\n"
+        "  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 7200,\n"
+        "              refresh_interval: 3600,\n"
+        "              learning_mode_duration: 0}\n"
+    )
+
+    def _free_port() -> int:
+        # The workers SO_REUSEPORT-bind the public port themselves;
+        # the tick process only needs to pick a free one for them.
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _held_total(pool) -> int:
+        return sum(pool.control.status()["worker_held"].values())
+
+    async def run():
+        import grpc
+
+        from doorman_tpu.proto.grpc_api import CapacityStub
+
+        server = CapacityServer(
+            "frontend-bench", TrivialElection(), mode="batch",
+            tick_interval=FRONTEND_TICK_INTERVAL,
+            minimum_refresh_interval=0.0, stream_push=True,
+            stream_shards=FANOUT_SHARDS, flightrec_capacity=0,
+        )
+        pool = server.attach_frontend(
+            FRONTEND_WORKERS, ring_bytes=FRONTEND_RING_BYTES,
+            inline=False,
+        )
+        public = f"127.0.0.1:{_free_port()}"
+        try:
+            backend_port = await server.start(0, host="127.0.0.1")
+            await server.load_config(config)
+            await asyncio.sleep(0)  # election callbacks land
+            server.current_master = public
+            # Ticks are manual below (the push-latency lap times the
+            # tick edge itself); the workers pump on their own clocks.
+            for task in server._tasks:
+                task.cancel()
+            server._tasks.clear()
+            await pool.start(public, f"127.0.0.1:{backend_port}")
+            ready_deadline = time.monotonic() + FRONTEND_READY_SECONDS
+            while time.monotonic() < ready_deadline:
+                held = pool.control.status()["worker_held"]
+                if len(held) == FRONTEND_WORKERS:
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                diagnostic({
+                    "diagnostic": "frontend_pool_not_ready",
+                    "workers": FRONTEND_WORKERS,
+                    "note": (
+                        "spawned listener workers never heartbeat the "
+                        "control surface within "
+                        f"{FRONTEND_READY_SECONDS:.0f}s — no metric row"
+                    ),
+                })
+                return
+
+            loop = asyncio.get_running_loop()
+            storm_procs = max(2, min(cores - FRONTEND_WORKERS - 1, 8))
+
+            # -- leg 1: establishment storm -------------------------
+            # Enough streams that establishing them saturates the
+            # whole window at the floor rate: the merged ok/elapsed
+            # IS the sustained establishment rate, not a burst tail.
+            est_streams = int(FRONTEND_EST_FLOOR * FRONTEND_EST_SECONDS)
+            est_workers = storm_procs * 16
+            try:
+                est = await loop.run_in_executor(None, lambda: (
+                    run_storm_procs(
+                        public, "storm", procs=storm_procs,
+                        workers=est_workers,
+                        duration=FRONTEND_EST_SECONDS, bands=(0,),
+                        wants=5.0, stream=True, seed=7,
+                        streams_per_worker=max(
+                            est_streams // est_workers, 1
+                        ),
+                        resource_spread=max(
+                            est_streams // FANOUT_SUBS_PER_RESOURCE, 1
+                        ),
+                    )
+                ))
+            except RuntimeError as exc:
+                diagnostic({
+                    "diagnostic": "frontend_storm_failed",
+                    "leg": "establishment",
+                    "error": str(exc),
+                })
+                return
+            est_rate = est["ok"] / max(est["duration_s"], 1e-9)
+            specs = [
+                slo_mod.SloSpec(
+                    name="server_frontend_establishment_storm:rate",
+                    kind="min", target=FRONTEND_EST_FLOOR,
+                    unit="est_per_s",
+                    source={"type": "scalar", "key": "est_rate"},
+                    description=(
+                        "sustained WatchCapacity establishments/sec "
+                        "through the pool's forwarded admission gate "
+                        "(ramp-batched, merged across storm processes)"
+                    ),
+                ),
+            ]
+            verdicts = slo_mod.SloEngine(specs).evaluate(
+                slo_mod.SloInputs(scalars={"est_rate": est_rate})
+            )
+            emit(
+                {
+                    "metric": "server_frontend_establishment_storm",
+                    "value": round(est_rate, 1),
+                    "unit": "est_per_s",
+                    "frontend_workers": FRONTEND_WORKERS,
+                    "storm_procs": storm_procs,
+                    "established": est["ok"],
+                    "shed": est["shed"],
+                    "errors": est["errors"],
+                    "establish_p50_s": est["p50_s"],
+                    "establish_p99_s": est["p99_s"],
+                    "duration_s": est["duration_s"],
+                    "slo": verdicts,
+                },
+                artifact_extra={"storm": est},
+            )
+            # The establishment population dropped at its deadline;
+            # let the workers' Drop forwards settle before holding.
+            settle = time.monotonic() + 10.0
+            while time.monotonic() < settle and _held_total(pool) > 0:
+                await asyncio.sleep(0.2)
+
+            # -- leg 2: held streams + push latency -----------------
+            held_kwargs = dict(
+                procs=storm_procs, workers=storm_procs * 32,
+                duration=FRONTEND_HOLD_SECONDS, bands=(0,), wants=5.0,
+                stream=True, seed=11,
+                streams_per_worker=max(
+                    FRONTEND_HELD_TARGET // (storm_procs * 32), 1
+                ),
+                resource_spread=max(
+                    FRONTEND_HELD_TARGET // FANOUT_SUBS_PER_RESOURCE, 1
+                ),
+            )
+            hold_future = loop.run_in_executor(None, lambda: (
+                run_storm_procs(public, "storm", **held_kwargs)
+            ))
+            held_max = 0
+            try:
+                # Track the held ceiling while the population ramps
+                # (heartbeats lag by their 1s interval; the max over
+                # the hold window is the honest ceiling).
+                ramp_deadline = time.monotonic() + (
+                    FRONTEND_HOLD_SECONDS / 2
+                )
+                while time.monotonic() < ramp_deadline:
+                    held_max = max(held_max, _held_total(pool))
+                    if held_max >= FRONTEND_HELD_TARGET:
+                        break
+                    await asyncio.sleep(0.5)
+
+                # Sentinel stream through the pool: its resource is
+                # churned each manual tick; the lap from the tick
+                # edge to the sentinel's WatchCapacity message is the
+                # client-observed push latency (publisher frame ->
+                # ring -> holding worker's pump -> gRPC write).
+                push_lat = []
+                async with grpc.aio.insecure_channel(public) as chan:
+                    stub = CapacityStub(chan)
+                    wreq = _spb.WatchCapacityRequest(
+                        client_id="bench-sentinel"
+                    )
+                    rr = wreq.resource.add()
+                    rr.resource_id = "sentinel"
+                    rr.wants = 10.0
+                    rr.priority = 1
+                    call = stub.WatchCapacity(wreq)
+                    # Establishment snapshot first (not a push).
+                    while True:
+                        msg = await asyncio.wait_for(
+                            call.read(), timeout=30.0
+                        )
+                        if msg is grpc.aio.EOF:
+                            raise ConnectionResetError(
+                                "sentinel stream ended at establish"
+                            )
+                        if msg.response:
+                            break
+                    for t in range(FRONTEND_PUSH_TICKS):
+                        wants = 500.0 if t % 2 == 0 else 1.0
+                        server._decide(
+                            "sentinel",
+                            _Request("churner", 0.0, wants, 1,
+                                     priority=0),
+                        )
+                        t0 = time.monotonic()
+                        await server.tick_once()
+                        while True:
+                            msg = await asyncio.wait_for(
+                                call.read(),
+                                timeout=10.0 * FRONTEND_TICK_INTERVAL,
+                            )
+                            if msg is grpc.aio.EOF:
+                                raise ConnectionResetError(
+                                    "sentinel stream reset mid-lap"
+                                )
+                            if msg.response:
+                                break
+                        push_lat.append(time.monotonic() - t0)
+                        held_max = max(held_max, _held_total(pool))
+                    call.cancel()
+            except (TimeoutError, asyncio.TimeoutError,
+                    ConnectionResetError, grpc.aio.AioRpcError) as exc:
+                diagnostic({
+                    "diagnostic": "frontend_push_lap_failed",
+                    "held_max": held_max,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "note": (
+                        "the sentinel push lap did not complete; no "
+                        "held-streams metric row"
+                    ),
+                })
+                return
+            finally:
+                try:
+                    hold = await hold_future
+                except RuntimeError as exc:
+                    hold = {"error": str(exc)}
+            push_lat.sort()
+            push_p99_ms = 1000.0 * percentile(push_lat, 0.99)
+            if held_max < FRONTEND_HELD_TARGET * 0.9:
+                diagnostic({
+                    "diagnostic": "frontend_held_under_target",
+                    "note": (
+                        f"the pool held {held_max} of "
+                        f"{FRONTEND_HELD_TARGET} target streams within "
+                        f"{FRONTEND_HOLD_SECONDS:.0f}s on this box"
+                    ),
+                    "held_max": held_max,
+                })
+            specs = [
+                slo_mod.SloSpec(
+                    name="server_frontend_held_streams:held",
+                    kind="min", target=float(FRONTEND_HELD_TARGET),
+                    unit="streams",
+                    source={"type": "scalar", "key": "held_max"},
+                    description=(
+                        "WatchCapacity streams held across the "
+                        "listener workers (control-surface heartbeat "
+                        "ceiling over the hold window)"
+                    ),
+                ),
+                slo_mod.SloSpec(
+                    name="server_frontend_held_streams:push_p99",
+                    kind="max",
+                    target=1000.0 * FRONTEND_TICK_INTERVAL, unit="ms",
+                    source={"type": "scalar", "key": "push_p99_ms"},
+                    description=(
+                        "client-observed push latency (tick edge -> "
+                        "sentinel WatchCapacity message through the "
+                        "ring and the holding worker) under the held "
+                        "population, p99 vs one tick interval"
+                    ),
+                ),
+            ]
+            verdicts = slo_mod.SloEngine(specs).evaluate(
+                slo_mod.SloInputs(scalars={
+                    "held_max": float(held_max),
+                    "push_p99_ms": push_p99_ms,
+                })
+            )
+            emit(
+                {
+                    "metric": "server_frontend_held_streams",
+                    "value": held_max,
+                    "unit": "streams",
+                    "frontend_workers": FRONTEND_WORKERS,
+                    "held_target": FRONTEND_HELD_TARGET,
+                    "push_p50_ms": round(
+                        1000.0 * percentile(push_lat, 0.50), 3
+                    ),
+                    "push_p99_ms": round(push_p99_ms, 3),
+                    "push_ticks": len(push_lat),
+                    "storm_pushes": hold.get("pushes", 0),
+                    "storm_resets": hold.get("resets", 0),
+                    "storm_errors": hold.get("errors", 0),
+                    "slo": verdicts,
+                },
+                artifact_extra={"storm": hold},
+            )
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
 def gate_pallas_kernels() -> None:
     """Real-TPU pallas regression gate: compile and run BOTH pallas
     kernels (dense lanes + banded priority water-fill) on the chip and
@@ -2776,6 +3143,7 @@ def bench_workload_scenarios() -> None:
     names = (
         "diurnal", "flash_crowd", "rolling_deploy", "multi_region",
         "elastic_preempt", "flash_crowd_predictive",
+        "diurnal_streaming_pooled",
     )
     for name in names:
         try:
@@ -2866,6 +3234,7 @@ if __name__ == "__main__":
         "rpc_storm": bench_server_rpc_storm,
         "push_vs_poll": bench_server_push_vs_poll,
         "stream_fanout": bench_server_stream_fanout_scaling,
+        "frontend": bench_server_frontend,
         "federated_roots": bench_server_tick_federated_roots,
         "workload": bench_workload_scenarios,
         "server_tick": bench_server_tick,
@@ -2925,6 +3294,10 @@ if __name__ == "__main__":
             # count (sublinearity SLO floor), quiet-tick independence,
             # and the multiplexed storm driver's held-stream count.
             bench_server_stream_fanout_scaling()
+            # Serving-plane pool: establishment storm + held-stream
+            # ceiling through the real SO_REUSEPORT worker pool
+            # (cores-gated — a diagnostic on single-core boxes).
+            bench_server_frontend()
             # Federated root tier: N shards ticking concurrently on
             # their own devices — aggregate leases/sec + scaling_vs_1root.
             bench_server_tick_federated_roots()

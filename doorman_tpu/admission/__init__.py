@@ -27,6 +27,7 @@ from typing import Dict, Optional
 from doorman_tpu.admission.coalesce import Coalescer
 from doorman_tpu.admission.controller import AimdController
 from doorman_tpu.admission.deadline import DecisionLatency, fast_fail_reason
+from doorman_tpu.admission.ramp import EstablishmentRamp
 from doorman_tpu.admission.policy import (
     RETRY_AFTER_KEY,
     SHED_MATRIX,
@@ -40,6 +41,7 @@ __all__ = [
     "AimdController",
     "Coalescer",
     "DecisionLatency",
+    "EstablishmentRamp",
     "RETRY_AFTER_KEY",
     "SHED_MATRIX",
     "Shed",
@@ -79,6 +81,13 @@ class Admission:
         # Plain dict (not the prometheus counters) so the chaos
         # invariants read exact deterministic integers.
         self.tallies: Dict = {}
+        # Frontend pool attribution: worker index -> the same tally
+        # shape, absorbed from listener-worker heartbeats (real pool)
+        # or stamped at establishment (inline pool). The gate itself
+        # runs HERE either way — these never double-count into
+        # `tallies`, they say which listener the traffic arrived
+        # through (/debug/frontend).
+        self.worker_tallies: Dict[int, Dict] = {}
 
         reg = metrics_mod.default_registry()
         self._requests = reg.counter(
@@ -200,6 +209,18 @@ class Admission:
             kind="overload",
         )
 
+    def absorb_worker_tallies(self, worker: int, tallies: Dict) -> None:
+        """Merge one frontend worker's tally DELTAS (keys
+        "method/band", counts since its last report) into the per-worker
+        attribution table."""
+        slot = self.worker_tallies.setdefault(int(worker), {})
+        for key, counts in tallies.items():
+            dst = slot.setdefault(
+                key, {"admitted": 0, "shed": 0, "fast_fail": 0}
+            )
+            for outcome, n in counts.items():
+                dst[outcome] = dst.get(outcome, 0) + int(n)
+
     def note_pass_through(self, method: str, band: int = 0) -> None:
         """Tally a never-shed method (the shed matrix's 'never' rows);
         these do not consume controller admit draws — they are load the
@@ -233,4 +254,8 @@ class Admission:
                 self.coalesce_window + self.latency.value, 6
             ),
             "tallies": tallies,
+            "worker_tallies": {
+                str(w): dict(v)
+                for w, v in sorted(self.worker_tallies.items())
+            },
         }

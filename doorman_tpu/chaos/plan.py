@@ -58,6 +58,19 @@ KINDS = frozenset(
         # straddle reconciler while active — its share stops renewing,
         # coasts to its ttl, then the shard decays to zero capacity.
         "shard_partition",
+        # serving-plane seam (setup["frontend_workers"] arms an inline
+        # frontend pool; doorman_tpu/frontend/):
+        # a listener worker dies while active — its WatchCapacity
+        # streams reset to a redirect (never a silent lapse), its
+        # stream shards reassign to survivors; the worker restarts at
+        # heal with a fresh ring cursor (no replay). params:
+        # {"worker": i}.
+        "worker_crash",
+        # a worker's ring pump freezes while active (the worker is
+        # alive but not draining its ring); a long enough stall laps
+        # the reader and the resume pump resets every held stream
+        # loudly. params: {"worker": i}.
+        "ring_stall",
     }
 )
 

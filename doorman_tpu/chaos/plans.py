@@ -364,6 +364,89 @@ def grant_corruption() -> FaultPlan:
     )
 
 
+def frontend_worker_crash() -> FaultPlan:
+    """Serving-plane crash arc: a batch master with an inline frontend
+    pool (2 listener workers over per-worker push rings) serves four
+    WatchCapacity stream clients next to three refresh clients. At the
+    fault tick, worker 0 dies for four ticks: every stream it held ends
+    with a mastership redirect THAT TICK (reset-to-redirect — never a
+    silent lapse), the dead worker's stream shards reassign to the
+    survivor, and the clients' next stream_step chases the redirect and
+    re-establishes — landing on worker 1, where pushes resume. At heal
+    the worker restarts with a FRESH ring cursor (no frame replay;
+    resume rides the push-seq contract) and new establishments home
+    back to it. Base allocations ride through byte-unchanged — the
+    serving plane is fanout only, the tick process never stopped
+    deciding — and the event log (crash, redirects, re-establishes,
+    restore) replays byte-identically."""
+    return FaultPlan(
+        name="frontend_worker_crash",
+        seed=12,
+        setup={
+            "servers": 1,
+            "clients": 3,
+            "wants": [20.0, 30.0, 60.0],
+            "capacity": 100,
+            "mode": "batch",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+            "streams": 4,
+            "stream_shards": 4,
+            "frontend_workers": 2,
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="worker_crash", target="s0",
+                       duration_ticks=4, params={"worker": 0}),
+        ],
+        warmup_ticks=8,
+        total_ticks=24,
+        reconverge_ticks=8,
+    )
+
+
+def frontend_ring_stall() -> FaultPlan:
+    """Serving-plane stall arc: same topology as the crash plan, but
+    worker 0's ring pump freezes for ten ticks over a deliberately tiny
+    ring (256 bytes). The tick edge keeps publishing (appends never
+    block — backpressure is the reader's problem, frontend/ring.py), so
+    the frozen reader is lapped; at resume the pump detects the lap and
+    resets EVERY stream the worker held to a redirect — the loud
+    failure mode the ring is designed for, instead of silently missing
+    pushes. Clients chase the redirect and re-establish; the survivor's
+    streams never notice."""
+    return FaultPlan(
+        name="frontend_ring_stall",
+        seed=13,
+        setup={
+            "servers": 1,
+            "clients": 3,
+            "wants": [20.0, 30.0, 60.0],
+            "capacity": 100,
+            "mode": "batch",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+            "streams": 4,
+            "stream_shards": 4,
+            "frontend_workers": 2,
+            # Small enough that a ten-tick stall laps the frozen reader
+            # (beats + per-tick lease-refresh pushes), but with headroom
+            # for a healthy tick's establishment snapshots.
+            "frontend_ring": 512,
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="ring_stall", target="s0",
+                       duration_ticks=10, params={"worker": 0}),
+        ],
+        warmup_ticks=8,
+        total_ticks=28,
+        reconverge_ticks=8,
+    )
+
+
 def _warm_variant(name, algorithm, variant):
     def build():
         return master_flap_warm(
@@ -393,6 +476,8 @@ PLANS: Dict[str, "callable"] = {
     ),
     "client_storm": client_storm,
     "etcd_brownout": etcd_brownout,
+    "frontend_worker_crash": frontend_worker_crash,
+    "frontend_ring_stall": frontend_ring_stall,
     "grant_corruption": grant_corruption,
     "device_tunnel_outage": device_tunnel_outage,
     "intermediate_partition": intermediate_partition,

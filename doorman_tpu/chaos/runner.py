@@ -174,6 +174,15 @@ class ChaosRunner:
         # arms, refreshed every storm tick AFTER the base clients,
         # closed (releasing) when it clears.
         self.storm_clients: List[Client] = []
+        # Serving-plane pools (setup["frontend_workers"]): an inline
+        # frontend pool per streaming server — pushes ride per-worker
+        # rings and a worker-core pump on the virtual clock, so the
+        # worker_crash / ring_stall fault kinds drive the same code the
+        # real listener processes run, byte-stably.
+        self.frontends: Dict[str, object] = {}
+        self._fe_crashed: Dict[str, set] = {}
+        self._fe_stalled: Dict[str, set] = {}
+        self._frontend_final: Dict[str, dict] = {}
         self._attach: str = ""
         self._admission_last: Dict[str, tuple] = {}
         self.kv: Optional[InMemoryKV] = None
@@ -338,6 +347,7 @@ class ChaosRunner:
                 # Streaming leg: every candidate serves WatchCapacity
                 # (the runner drives the fanout beat explicitly).
                 stream_push=bool(s.get("streams")),
+                stream_shards=int(s.get("stream_shards", 1)),
                 shard=i if fed else None,
                 # Shadow audit (setup["audit_sample"]): comparisons run
                 # INLINE on the virtual clock so divergence counts land
@@ -351,6 +361,13 @@ class ChaosRunner:
             await _cancel_background(server)
             proxy.backend = server
             await server.load_config(config)
+            if s.get("frontend_workers") and s.get("streams"):
+                self.frontends[name] = server.attach_frontend(
+                    int(s["frontend_workers"]),
+                    ring_bytes=int(s.get("frontend_ring", 1 << 20)),
+                )
+                self._fe_crashed[name] = set()
+                self._fe_stalled[name] = set()
             self.servers[name] = server
             self.proxies[name] = proxy
             self.elections[name] = election
@@ -442,6 +459,12 @@ class ChaosRunner:
             self.stream_clients.append(client)
 
     async def _teardown(self) -> None:
+        # Snapshot serving-plane status before the pools close (their
+        # ring buffers are released by server.stop()).
+        self._frontend_final = {
+            name: pool.status()
+            for name, pool in sorted(self.frontends.items())
+        }
         for client in self.clients + self.stream_clients + self.storm_clients:
             try:
                 await client.close()
@@ -525,6 +548,47 @@ class ChaosRunner:
                 await client.close()
             self.log.append([tick, "storm_end", len(swarm)])
 
+    def _drive_frontend(self, tick: int) -> None:
+        """The serving-plane fault seam: translate active worker_crash
+        / ring_stall events into inline-pool faults, and heal them when
+        the events clear. A crash drops the worker's streams to
+        redirects the same tick (the clients' next stream_step chases
+        them); a restore brings the worker back with a fresh ring
+        cursor. A stall freezes the worker's pump; the resume pump
+        surfaces the lap and resets loudly (logged by _drive_streams'
+        pump entry)."""
+        for name, pool in self.frontends.items():
+            crashed = self._fe_crashed[name]
+            params = self.state.active("worker_crash", name)
+            if params is not None:
+                worker = int(params.get("worker", 0))
+                if worker not in crashed:
+                    crashed.add(worker)
+                    dropped = pool.crash(worker)
+                    self.log.append(
+                        [tick, "worker_crash", name, worker, dropped]
+                    )
+            elif crashed:
+                for worker in sorted(crashed):
+                    pool.restore(worker)
+                    self.log.append(
+                        [tick, "worker_restore", name, worker]
+                    )
+                crashed.clear()
+            stalled = self._fe_stalled[name]
+            params = self.state.active("ring_stall", name)
+            if params is not None:
+                worker = int(params.get("worker", 0))
+                if worker not in stalled:
+                    stalled.add(worker)
+                    pool.stall(worker)
+                    self.log.append([tick, "ring_stall", name, worker])
+            elif stalled:
+                for worker in sorted(stalled):
+                    pool.unstall(worker)
+                    self.log.append([tick, "ring_resume", name, worker])
+                stalled.clear()
+
     async def _drive_streams(self, tick: int) -> None:
         """The streaming leg's per-tick beat: the master fans out lease
         deltas at the tick edge (the runner owns the cadence — server
@@ -533,11 +597,22 @@ class ChaosRunner:
         fall back to a poll while the stream is down or silent). One
         event-log entry per client per tick where anything happened, so
         the flap's terminate→redirect→poll→re-establish arc is pinned
-        byte-for-byte by the determinism check."""
+        byte-for-byte by the determinism check. With a frontend pool
+        attached, the fanout's ring frames are pumped to subscribers
+        here (where a real worker's poll loop would have woken); pump
+        anomalies — laps, deadline-wheel resets — get their own log
+        entry."""
         if not self.stream_clients:
             return
         for server in self.servers.values():
             server.push_streams()
+        for name, pool in self.frontends.items():
+            stats = pool.pump_all()
+            if stats["lapped"] or stats["corrupt"] or stats["stalled"]:
+                self.log.append([
+                    tick, "frontend_pump", name, stats["frames"],
+                    stats["lapped"], stats["corrupt"], stats["stalled"],
+                ])
         for client in self.stream_clients:
             out = await client.stream_step(drain_timeout=0.05)
             if out["events"] or out["pushes"]:
@@ -682,6 +757,18 @@ class ChaosRunner:
             rec["admission"] = admission
         if streams:
             rec["streams"] = streams
+        if self.frontends:
+            # The serving plane on the black box: held streams and
+            # crash/restore counts per pool (counters of virtual-clock
+            # events, so byte-stable).
+            rec["frontend"] = {
+                name: {
+                    "held": pool.held(),
+                    "crashes": pool.crashes,
+                    "restores": pool.restores,
+                }
+                for name, pool in sorted(self.frontends.items())
+            }
         if self.federation is not None:
             # The federation beat on the black box: each shard's
             # installed straddle capacity (deterministic plan
@@ -874,6 +961,7 @@ class ChaosRunner:
                 for client in self.clients:
                     await client.refresh_once()
 
+                self._drive_frontend(tick)
                 await self._drive_streams(tick)
                 await self._drive_storm(tick)
                 self._log_admission(tick)
@@ -977,6 +1065,11 @@ class ChaosRunner:
             ),
             "violations": [v.as_log() for v in self.violations],
             "admission": admission_tallies,
+            # Serving-plane outcome per pooled server (None when the
+            # plan arms no frontend pool): worker/ring counters and the
+            # final stream placement — deterministic, virtual-clock
+            # driven; snapshotted at teardown before the rings close.
+            "frontend": self._frontend_final or None,
             # Shadow-audit outcome per audited server (None when the
             # plan doesn't arm the auditor): sample/divergence counts
             # and the bounded detail rows, byte-stable because chaos

@@ -218,6 +218,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "past it sheds with RESOURCE_EXHAUSTED + "
                         "retry-after so fanout cannot starve the "
                         "tick; 0 = unlimited")
+    p.add_argument("--frontend-workers", type=int, default=0,
+                   help="serving-plane scale-out: run this many "
+                        "SO_REUSEPORT listener worker PROCESSES on "
+                        "--port and move the gRPC backend to an "
+                        "ephemeral loopback port — workers hold the "
+                        "WatchCapacity streams (pushes fan out over "
+                        "per-worker shared-memory rings, zero "
+                        "re-encode) and forward unary RPCs to the tick "
+                        "process; a dead worker's streams reset to "
+                        "redirects and it respawns with a fresh ring "
+                        "cursor. Needs --stream-push; 0 keeps the "
+                        "single-process server (doc/serving.md)")
+    p.add_argument("--frontend-ring-bytes", type=int, default=1 << 22,
+                   help="per-worker push-ring capacity in bytes; size "
+                        "to hold a few ticks of push traffic — a "
+                        "worker that falls a full ring behind laps and "
+                        "resets its streams loudly")
     p.add_argument("--stream-shards", type=int, default=1,
                    help="stream push: partition subscribers across "
                         "this many fanout shards (stable client-id "
@@ -394,13 +411,46 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
     if args.audit_sample:
         log.info("shadow-oracle audit every %d ticks", args.audit_sample)
 
-    port = await server.start(
-        args.port,
-        host=args.host,
-        tls_cert=args.tls_cert or None,
-        tls_key=args.tls_key or None,
-    )
-    log.info("serving gRPC on %s:%d", args.host, port)
+    frontend = None
+    if args.frontend_workers > 0:
+        if not args.stream_push:
+            log.error("--frontend-workers needs --stream-push (the "
+                      "workers exist to hold WatchCapacity streams)")
+            raise SystemExit(2)
+        if args.tls_cert or args.tls_key:
+            log.error("--frontend-workers does not serve TLS yet; "
+                      "terminate TLS in front of the pool or drop "
+                      "--tls-cert/--tls-key")
+            raise SystemExit(2)
+        # Construct BEFORE start(): the pool's control surface
+        # (Establish/Drop/Heartbeat) registers on the backend gRPC
+        # server at start().
+        frontend = server.attach_frontend(
+            args.frontend_workers,
+            ring_bytes=args.frontend_ring_bytes,
+            inline=False,
+            ramp_window=args.coalesce_window if args.admission else 0.0,
+        )
+
+    if frontend is not None:
+        # The tick process retreats to an ephemeral loopback backend;
+        # the workers own the public port via SO_REUSEPORT.
+        backend_port = await server.start(0, host="127.0.0.1")
+        await frontend.start(
+            f"{args.host}:{args.port}",
+            f"127.0.0.1:{backend_port}",
+        )
+        log.info("serving gRPC on %s:%d via %d frontend workers "
+                 "(backend 127.0.0.1:%d)", args.host, args.port,
+                 args.frontend_workers, backend_port)
+    else:
+        port = await server.start(
+            args.port,
+            host=args.host,
+            tls_cert=args.tls_cert or None,
+            tls_key=args.tls_key or None,
+        )
+        log.info("serving gRPC on %s:%d", args.host, port)
 
     if args.trace:
         default_tracer().enable(capacity=args.trace_buffer)

@@ -39,6 +39,9 @@ ROUTES = (
     ("/debug/admission", "admission control: AIMD level, per-band "
                          "admit probabilities, shed tallies, coalescing "
                          "windows (?format=json)"),
+    ("/debug/frontend", "serving-plane pool: worker liveness, held "
+                        "streams, ring publish/pump counters, control "
+                        "surface tallies (?format=json)"),
     ("/debug/traces", "span tracer summary; ?format=chrome downloads a "
                       "Perfetto-loadable trace"),
     ("/debug/slo", "SLO verdicts per server (tick budget, RPC p99, "
@@ -422,6 +425,96 @@ class DebugServer:
             title="/debug/admission", body="".join(sections)
         )
 
+    def _frontend_statuses(self) -> Dict[str, Optional[dict]]:
+        """server id -> serving-plane pool status (None when no
+        frontend pool is attached), snapshotted on each owning loop
+        (the inline pool's status reads live ring control words)."""
+        out: Dict[str, Optional[dict]] = {}
+        for server, loop in self._servers:
+            pool = getattr(server, "_frontend", None)
+            out[server.id] = (
+                self._call(loop, pool.status)
+                if pool is not None else None
+            )
+        return out
+
+    def _frontend_page(self) -> str:
+        sections = []
+        for sid, st in self._frontend_statuses().items():
+            if st is None:
+                sections.append(
+                    f"<h2>server {html.escape(sid)}</h2>"
+                    "<p>no frontend pool attached</p>"
+                )
+                continue
+            pub = st.get("publisher") or {}
+            live = st.get("live", [])
+            parts = [
+                f"mode: {html.escape(str(st.get('mode', '?')))}",
+                f"workers live: {len(live)}/{st.get('workers', 0)}",
+                f"published: {pub.get('published_frames', 0)} frames"
+                f" / {pub.get('published_bytes', 0)} bytes"
+                f" ({pub.get('terminals', 0)} terminals)",
+            ]
+            if "held" in st:
+                parts.append(f"held: {st['held']}")
+            if "crashes" in st:
+                parts.append(
+                    f"crashes: {st['crashes']} "
+                    f"(restores: {st.get('restores', 0)})"
+                )
+            if st.get("public_addr"):
+                parts.append(
+                    "public: " + html.escape(str(st["public_addr"]))
+                )
+            body = [f"<h2>server {html.escape(sid)}</h2>"
+                    f"<p>{' | '.join(parts)}</p>"]
+            per_worker = st.get("per_worker") or []
+            if per_worker:
+                # Inline pool: the in-process worker cores expose the
+                # full pump/stall counters.
+                rows = "".join(
+                    f"<tr><td>{w.get('worker')}</td>"
+                    f"<td>{w.get('held', 0)}</td>"
+                    f"<td>{w.get('frames', 0)}</td>"
+                    f"<td>{w.get('pushes', 0)}</td>"
+                    f"<td>{w.get('terminals', 0)}</td>"
+                    f"<td>{w.get('stalls', 0)}</td>"
+                    f"<td>{w.get('desyncs', 0)}</td>"
+                    f"<td>{w.get('parked', 0)}</td></tr>"
+                    for w in per_worker
+                )
+                body.append(
+                    "<table><tr><th>worker</th><th>held</th>"
+                    "<th>frames</th><th>pushes</th><th>terminals</th>"
+                    "<th>stalls</th><th>desyncs</th><th>parked</th>"
+                    f"</tr>{rows}</table>"
+                )
+            control = st.get("control") or {}
+            if control:
+                # Process pool: the control surface's view (heartbeats
+                # are the workers' own reports).
+                held_rows = "".join(
+                    f"<tr><td>{html.escape(w)}</td><td>{n}</td></tr>"
+                    for w, n in sorted(
+                        (control.get("worker_held") or {}).items()
+                    )
+                )
+                body.append(
+                    f"<p>establishments: "
+                    f"{control.get('establishments', 0)} | drops: "
+                    f"{control.get('drops', 0)} | heartbeats: "
+                    f"{control.get('heartbeats', 0)}</p>"
+                    "<table><tr><th>worker</th><th>held (last "
+                    f"heartbeat)</th></tr>{held_rows}</table>"
+                )
+            sections.append("".join(body))
+        if not sections:
+            sections.append("<p>no servers</p>")
+        return _PAGE.format(
+            title="/debug/frontend", body="".join(sections)
+        )
+
     def _slo_statuses(self) -> Dict[str, Optional[dict]]:
         """server id -> last_slo dict (a fresh evaluation per request;
         None when the server has no SLO support), each snapshotted on
@@ -704,6 +797,21 @@ class DebugServer:
                         else:
                             body, ctype = (
                                 debug._admission_page(),
+                                "text/html",
+                            )
+                    elif url.path == "/debug/frontend":
+                        q = parse_qs(url.query)
+                        if q.get("format", [""])[0] == "json":
+                            body, ctype = (
+                                json.dumps(
+                                    debug._frontend_statuses(),
+                                    indent=2, default=str,
+                                ),
+                                "application/json",
+                            )
+                        else:
+                            body, ctype = (
+                                debug._frontend_page(),
                                 "text/html",
                             )
                     elif url.path == "/debug/slo":

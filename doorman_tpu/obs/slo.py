@@ -582,6 +582,19 @@ _WORKLOAD_GATES: Dict[str, tuple] = {
         "pushes",
         "lease deltas pushed to WatchCapacity subscribers",
     ),
+    "frontend_frames": (
+        "min",
+        {"type": "scalar", "key": "frontend_frames"},
+        "frames",
+        "ring frames pumped through the frontend worker pool "
+        "(the serving plane visibly carried the stream traffic)",
+    ),
+    "frontend_held": (
+        "min",
+        {"type": "scalar", "key": "frontend_held"},
+        "streams",
+        "WatchCapacity streams held by frontend workers at run end",
+    ),
 }
 
 

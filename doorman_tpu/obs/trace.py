@@ -96,6 +96,11 @@ KNOWN_SPAN_NAMES = frozenset({
     # Workload harness (doorman_tpu/workload): one span per scenario
     # run, wrapping the whole stepped drive.
     "workload.scenario",
+    # Serving-plane listener workers (doorman_tpu/frontend/worker.py):
+    # one pump lap (ring drain + deadline wheel) and one held
+    # WatchCapacity stream's serve loop.
+    "frontend.pump",
+    "frontend.stream",
 })
 KNOWN_INSTANT_NAMES = frozenset({
     "election.transition",
@@ -110,6 +115,9 @@ KNOWN_INSTANT_NAMES = frozenset({
     # stamped by the server's tick loop off the hot path.
     "audit.divergence",
     "detect.anomaly",
+    # A frontend worker declaring a held stream stalled (its ring
+    # frame overdue past the stall margin) before resetting it.
+    "frontend.stall",
 })
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must

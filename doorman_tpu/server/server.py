@@ -27,7 +27,7 @@ from typing import Callable, Dict, List, Optional
 
 import grpc
 
-from doorman_tpu.admission.policy import RETRY_AFTER_KEY
+from doorman_tpu.admission.policy import RETRY_AFTER_KEY, Shed
 from doorman_tpu.algorithms import Request
 from doorman_tpu.algorithms.kinds import AlgoKind
 from doorman_tpu.core.resource import Resource, algo_kind_for
@@ -283,6 +283,14 @@ class CapacityServer(CapacityServicer):
                 self, max_streams_per_band=max_streams_per_band,
                 shards=stream_shards,
             )
+        # Frontend serving pool (doorman_tpu.frontend): the multi-
+        # process SO_REUSEPORT listener plane. attach_frontend wires an
+        # inline or process pool; the control surface registers on the
+        # backend gRPC server at start(); the establishment ramp
+        # micro-batches forwarded stream establishments.
+        self._frontend = None
+        self._frontend_control = None
+        self._frontend_ramp = None
         # Delta bookkeeping for the fanout: ticks whose changes have no
         # tracked source (python store, overflow fallback, wide/priority
         # solver parts, config epoch moves) force a full subscription
@@ -398,6 +406,13 @@ class CapacityServer(CapacityServicer):
         self._loop = asyncio.get_running_loop()
         server = grpc.aio.server()
         add_capacity_servicer(server, self)
+        if self._frontend_control is not None:
+            # The frontend pool's control surface (Establish / Drop /
+            # Heartbeat) rides the backend gRPC server; handlers must
+            # register before the server starts.
+            from doorman_tpu.frontend.control import add_frontend_control
+
+            add_frontend_control(server, self._frontend_control)
         if tls_cert or tls_key:
             if not (tls_cert and tls_key):
                 raise ValueError("tls_cert and tls_key must both be set")
@@ -434,8 +449,52 @@ class CapacityServer(CapacityServicer):
             self._tasks.append(asyncio.create_task(self._stream_loop()))
         return self.port
 
+    def attach_frontend(self, workers: int, *, ring_bytes: int = 1 << 20,
+                        inline: bool = True, ramp_window: float = 0.0,
+                        stall_margin: float = 3.0):
+        """Attach the serving-plane pool (doorman_tpu.frontend): N
+        listener workers over per-worker push rings, plus the
+        establishment ramp. `inline=True` builds the deterministic
+        same-process pool (tests, chaos, workload harness — call
+        `pump_all()` after push edges); `inline=False` builds the real
+        process pool (construct BEFORE start(); its control surface
+        registers on the backend gRPC server at start). Returns the
+        pool."""
+        if self._streams is None:
+            raise ValueError(
+                "attach_frontend needs stream push enabled (stream_push)"
+            )
+        from doorman_tpu.admission.ramp import EstablishmentRamp
+        from doorman_tpu.frontend.pool import (
+            FrontendPool,
+            InlineFrontendPool,
+        )
+
+        if inline:
+            self._frontend = InlineFrontendPool(
+                self, workers, ring_bytes=ring_bytes,
+                stall_margin=stall_margin,
+            )
+        else:
+            self._frontend = FrontendPool(
+                self, workers, ring_bytes=ring_bytes,
+                tick_interval=self.tick_interval,
+            )
+        self._frontend_ramp = EstablishmentRamp(window=ramp_window)
+        return self._frontend
+
     async def stop(self) -> None:
         self._stop_profiler()
+        if self._frontend_ramp is not None:
+            self._frontend_ramp.close()
+            self._frontend_ramp = None
+        if self._frontend is not None:
+            closer = getattr(self._frontend, "stop", None)
+            if closer is not None:
+                await closer()
+            else:
+                self._frontend.close()
+            self._frontend = None
         if self._streams is not None:
             self._streams.close()
         for t in self._tasks:
@@ -1464,6 +1523,18 @@ class CapacityServer(CapacityServicer):
             rec["stream_shards"] = st["stream_shards"]
             rec["matched_pairs"] = st["matched_pairs"]
             rec["serialized_bytes"] = st["serialized_bytes"]
+        if self._frontend is not None:
+            # Serving-plane pool shape: streams held across listener
+            # workers and the frames the tick published to the rings —
+            # the triage counters for "a worker fell behind its ring".
+            rec["frontend_held"] = self._frontend.held() if hasattr(
+                self._frontend, "held"
+            ) else sum(
+                self._frontend.control.worker_held.values()
+            )
+            rec["frontend_frames"] = (
+                self._frontend.publisher.published_frames
+            )
         if self._admission is not None:
             admitted = 0
             shed_by_band: Dict[str, int] = {}
@@ -1746,24 +1817,39 @@ class CapacityServer(CapacityServicer):
                 band = max(
                     (rr.priority for rr in request.resource), default=0
                 )
-                shed = None
-                if self._admission is not None:
-                    shed = self._admission.check_watch(request)
-                if shed is None:
-                    shed = self._streams.check_cap(band)
-                if shed is not None:
+
+                def _establish():
+                    """Gate + subscribe, in arrival order — ridden
+                    directly or through the establishment ramp's
+                    grid-aligned window (admission/ramp.py)."""
+                    shed = None
+                    if self._admission is not None:
+                        shed = self._admission.check_watch(request)
+                    if shed is None:
+                        shed = self._streams.check_cap(band)
+                    if shed is not None:
+                        return shed
+                    sub = self._streams.subscribe(request)
+                    # Bind the new stream into the device matcher's
+                    # incidence structure (a point scatter, not a
+                    # rebuild).
+                    self._stream_match_add(sub)
+                    return sub
+
+                if self._frontend_ramp is not None:
+                    result = await self._frontend_ramp.submit(_establish)
+                else:
+                    result = _establish()
+                if isinstance(result, Shed):
                     # Same wire contract as a shed poll: the pacing
                     # hint rides trailing metadata (doc/admission.md).
                     context.set_trailing_metadata((
-                        (RETRY_AFTER_KEY, f"{shed.retry_after:.3f}"),
+                        (RETRY_AFTER_KEY, f"{result.retry_after:.3f}"),
                     ))
                     await context.abort(
-                        grpc.StatusCode.RESOURCE_EXHAUSTED, shed.reason
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, result.reason
                     )
-                sub = self._streams.subscribe(request)
-                # Bind the new stream into the device matcher's
-                # incidence structure (a point scatter, not a rebuild).
-                self._stream_match_add(sub)
+                sub = result
                 err = False
         finally:
             dur = self._clock() - start
@@ -2223,6 +2309,24 @@ class CapacityServer(CapacityServicer):
             "streams": (
                 self._streams.status()
                 if self._streams is not None
+                else None
+            ),
+            # Serving-plane pool (None: single-process front-end).
+            "frontend": (
+                {
+                    **self._frontend.status(),
+                    "ramp": (
+                        self._frontend_ramp.status()
+                        if self._frontend_ramp is not None
+                        else None
+                    ),
+                    "control": (
+                        self._frontend_control.status()
+                        if self._frontend_control is not None
+                        else None
+                    ),
+                }
+                if self._frontend is not None
                 else None
             ),
             # Federation identity + traffic (None: unsharded server

@@ -128,7 +128,8 @@ class Subscription:
 
     __slots__ = (
         "client_id", "band", "lines", "last", "queue", "next_refresh",
-        "terminated", "shard", "match_slot", "order",
+        "terminated", "shard", "match_slot", "order", "stream_id",
+        "worker",
     )
 
     def __init__(self, client_id: str, band: int,
@@ -151,6 +152,12 @@ class Subscription:
         self.shard = shard
         # Device-matcher slot (server/match.py); owned by the server.
         self.match_slot: "int | None" = None
+        # Frontend pool routing (doorman_tpu/frontend): a pooled
+        # subscription is addressed on the push ring by stream_id and
+        # owned by exactly one listener worker, pinned at
+        # establishment. worker=None is the in-process path.
+        self.stream_id = 0
+        self.worker: "int | None" = None
 
 
 class StreamShard:
@@ -372,6 +379,17 @@ class StreamShard:
         sub.terminated = True
         msg = spb.WatchCapacityResponse(seq=self._next_seq())
         msg.mastership.CopyFrom(mastership)
+        publisher = self._registry.publisher
+        if sub.worker is not None and publisher is not None:
+            # Pooled stream: the terminal rides the owning worker's
+            # ring as a KIND_TERMINAL frame (the worker sends the bytes
+            # and ends the stream). A dead worker can't deliver — the
+            # registry's drop_worker sweep is the teardown there.
+            if publisher.publish_terminal(
+                sub.worker, self.index, sub.stream_id,
+                msg.SerializeToString(),
+            ):
+                return
         while True:
             try:
                 sub.queue.put_nowait(msg)
@@ -453,11 +471,24 @@ class StreamShard:
                 n_rows: int) -> None:
         if sub.terminated:
             return
-        try:
-            sub.queue.put_nowait(payload)
-        except asyncio.QueueFull:
-            self.reset(sub)
-            return
+        publisher = self._registry.publisher
+        if sub.worker is not None and publisher is not None:
+            # Pooled stream: the SAME pre-serialized bytes ride the
+            # owning worker's ring instead of the local queue (the
+            # zero-re-encode seam — the pooled-parity pin in
+            # tests/test_frontend.py is byte equality over this path).
+            # A dead worker drops the frame; drop_worker's sweep ends
+            # the stream, so the client re-establishes, never lapses.
+            if not publisher.publish(
+                sub.worker, self.index, sub.stream_id, payload
+            ):
+                return
+        else:
+            try:
+                sub.queue.put_nowait(payload)
+            except asyncio.QueueFull:
+                self.reset(sub)
+                return
         size = len(payload)
         self.total_messages += 1
         self.total_deltas += n_rows
@@ -520,6 +551,18 @@ class StreamRegistry:
         self.last_fanout_seconds = 0.0
         self._tick_matched = 0
         self._order = 0  # establishment sequence (canonical decide order)
+        # Frontend pool seam (doorman_tpu/frontend): when a
+        # RingPublisher is attached, every new subscription is pooled —
+        # pinned to the worker owning its stream shard, addressed by a
+        # registry-global stream_id, with pushes routed onto the ring.
+        self.publisher = None
+        self._stream_ids = 0
+        self._by_stream_id: Dict[int, Subscription] = {}
+        # Inline pool registration hook: called with the new pooled sub
+        # BEFORE its snapshot publishes, so the worker core never parks
+        # establishment frames. Real workers register via the Establish
+        # reply instead (frontend/control.py) and this stays None.
+        self.on_pooled_subscribe = None
 
     # -- routing -------------------------------------------------------
 
@@ -561,7 +604,8 @@ class StreamRegistry:
             )
         return None
 
-    def subscribe(self, request) -> Subscription:
+    def subscribe(self, request, worker: "Optional[int]" = None
+                  ) -> Subscription:
         band = max((rr.priority for rr in request.resource), default=0)
         lines = {
             rr.resource_id: (rr.wants, rr.priority)
@@ -572,11 +616,77 @@ class StreamRegistry:
                            shard=shard.index)
         self._order += 1
         sub.order = self._order
+        if self.publisher is not None:
+            # Pooled routing is pinned BEFORE the first message builds:
+            # the establishment snapshot already rides the ring. The
+            # inline pool routes by the shard's home worker; a REAL
+            # worker passes itself (`worker`) — SO_REUSEPORT hands the
+            # TCP connection to an arbitrary worker, and the frames
+            # must ride the ring of the worker that holds the stream.
+            self._stream_ids += 1
+            sub.stream_id = self._stream_ids
+            sub.worker = (
+                worker if worker is not None
+                else self.publisher.shard_worker(shard.index)
+            )
+            self._by_stream_id[sub.stream_id] = sub
+            if self.on_pooled_subscribe is not None:
+                self.on_pooled_subscribe(sub)
         shard.subscribe(request, sub)
         return sub
 
     def unsubscribe(self, sub: Subscription) -> None:
         self._shards[sub.shard].unsubscribe(sub)
+        if sub.stream_id:
+            self._by_stream_id.pop(sub.stream_id, None)
+
+    # -- frontend pool handoff -----------------------------------------
+
+    def attach_publisher(self, publisher) -> None:
+        """Attach the frontend pool's RingPublisher. Existing
+        subscriptions stay in-process (worker=None); only streams
+        established afterwards are pooled — attachment happens before
+        the listener opens, so in practice all of them."""
+        self.publisher = publisher
+
+    def stream_by_id(self, stream_id: int) -> "Optional[Subscription]":
+        return self._by_stream_id.get(stream_id)
+
+    def worker_subs(self, worker: int) -> List[Subscription]:
+        return [
+            sub for sub in self._by_stream_id.values()
+            if sub.worker == worker
+        ]
+
+    def drop_worker(self, worker: int, mastership) -> int:
+        """One listener worker died: remap its stream shards to the
+        survivors and end every stream it held — terminal redirects
+        delivered LOCALLY (the worker that would have pumped the ring
+        is gone; in the real pool the TCP teardown already reset the
+        clients, in the inline pool the local queue models it). The
+        clients re-establish — routed to a surviving worker by the
+        reassigned map — and resume from seq: per-shard seq counters
+        never reset, so the resumed stream sees no replay and no gap.
+        Returns the number of streams dropped. Never silent-lapse: a
+        crash is a loud terminal, not a quiet stall."""
+        if self.publisher is not None:
+            self.publisher.reassign(worker)
+        dropped = 0
+        for sub in self.worker_subs(worker):
+            if not sub.terminated:
+                # Clear the worker pin first so the terminal takes the
+                # local-queue path (the dead worker's ring has no
+                # reader to forward it).
+                sub.worker = None
+                self._shards[sub.shard].terminate(sub, mastership)
+                dropped += 1
+            self.unsubscribe(sub)
+        if dropped:
+            log.info(
+                "%s: frontend worker %d lost — dropped %d stream(s) "
+                "with redirects", self._server.id, worker, dropped,
+            )
+        return dropped
 
     # -- the tick-edge fanout ------------------------------------------
 
@@ -637,6 +747,11 @@ class StreamRegistry:
         if not work:
             for shard, due in zip(self._shards, due_by_shard):
                 shard.advance_refresh(now, due)
+            if self.publisher is not None:
+                # Quiet tick is still a push edge: the beat is how a
+                # worker's deadline wheel tells "nothing to push" from
+                # "ring stalled" (frontend/ring.py KIND_BEAT).
+                self.publisher.beat()
             self.last_fanout_seconds = time.perf_counter() - t0
             return
         decided = self._decide_all(work)
@@ -677,6 +792,8 @@ class StreamRegistry:
                 shard.enqueue(sub, payload, n_rows)
         for shard, due in zip(self._shards, due_by_shard):
             shard.advance_refresh(now, due)
+        if self.publisher is not None:
+            self.publisher.beat()
         self.last_fanout_seconds = time.perf_counter() - t0
 
     def _decide_all(self, work: List[Tuple[str, Request]]) -> List[tuple]:
@@ -834,4 +951,8 @@ class StreamRegistry:
             "resets_total": self.total_resets,
             "last_fanout_ms": round(self.last_fanout_seconds * 1000.0, 3),
             "per_shard": [s.status() for s in self._shards],
+            "frontend": (
+                self.publisher.status()
+                if self.publisher is not None else None
+            ),
         }

@@ -103,6 +103,12 @@ class WorkloadRunner:
         self.federation = None
         self.clients: Dict[str, Client] = {}
         self.stream_clients: List[Client] = []
+        # Serving-plane pools (spec.frontend_workers > 0): one inline
+        # frontend pool per server, pumped at the tick edge where a
+        # real worker's poll loop would have woken.
+        self.frontends: Dict[str, object] = {}
+        self._frontend_frames = 0
+        self._frontend_final: Dict[str, dict] = {}
         self.client_meta: Dict[str, dict] = {}
         self._client_shard: Dict[str, Optional[int]] = {}
         self.generators = gen_mod.build(spec)
@@ -275,12 +281,18 @@ class WorkloadRunner:
                 clock=self.clock,
                 admission=admission,
                 stream_push=bool(spec.stream_clients),
+                stream_shards=int(spec.stream_shards),
                 shard=i if fed else None,
             )
             await server.start(0, host="127.0.0.1")
             await _cancel_background(server)
             proxy.backend = server
             await server.load_config(config)
+            if spec.frontend_workers and spec.stream_clients:
+                self.frontends[name] = server.attach_frontend(
+                    int(spec.frontend_workers),
+                    ring_bytes=int(spec.frontend_ring),
+                )
             self.servers[name] = server
             self.proxies[name] = proxy
             self.elections[name] = election
@@ -359,6 +371,13 @@ class WorkloadRunner:
             await g.setup(self)
 
     async def _teardown(self) -> None:
+        # Snapshot the pools' final shape BEFORE stopping anything:
+        # WorkerCore.status() reads its ring's control words, and
+        # server.stop() releases the ring buffers.
+        self._frontend_final = {
+            name: pool.status()
+            for name, pool in sorted(self.frontends.items())
+        }
         for client in list(self.clients.values()) + self.stream_clients:
             try:
                 await client.close()
@@ -419,6 +438,14 @@ class WorkloadRunner:
             return
         for server in self.servers.values():
             server.push_streams()
+        for name, pool in self.frontends.items():
+            stats = pool.pump_all()
+            self._frontend_frames += stats["frames"]
+            if stats["lapped"] or stats["corrupt"] or stats["stalled"]:
+                self.log.append([
+                    tick, "frontend_pump", name, stats["frames"],
+                    stats["lapped"], stats["corrupt"], stats["stalled"],
+                ])
         for client in self.stream_clients:
             out = await client.stream_step(drain_timeout=0.05)
             self._stream_pushes += out["pushes"]
@@ -533,6 +560,10 @@ class WorkloadRunner:
         }
         rec["population"] = len(self.clients)
         rec["offered"] = sum(self._offered_by_band.values())
+        if self.frontends:
+            rec["frontend_held"] = sum(
+                pool.held() for pool in self.frontends.values()
+            )
         for name, server in sorted(self.servers.items()):
             adm = getattr(server, "_admission", None)
             if adm is not None:
@@ -637,6 +668,12 @@ class WorkloadRunner:
             "completions": float(self.counters.get("completions", 0)),
             "preemptions": float(self.counters.get("preemptions", 0)),
         }
+        if self.frontends or self._frontend_final:
+            scalars["frontend_frames"] = float(self._frontend_frames)
+            scalars["frontend_held"] = float(sum(
+                st.get("held", 0)
+                for st in self._frontend_final.values()
+            ))
         if self._refresh_attempts:
             scalars["refresh_ok_ratio"] = (
                 self._refresh_ok / self._refresh_attempts
@@ -723,6 +760,7 @@ class WorkloadRunner:
             "ticks": spec.ticks,
             "tick_interval": self.tick_interval,
             "summary": summary,
+            "frontend": self._frontend_final or None,
             "slo": {"ok": ok, "verdicts": verdicts},
             "flightrec_dump": self.flight_dump,
             "event_log": self.log,

@@ -222,6 +222,41 @@ def diurnal_streaming(scale: float = 1.0, seed: int = 0,
     )
 
 
+def diurnal_streaming_pooled(scale: float = 1.0, seed: int = 0,
+                             ticks: Optional[int] = None
+                             ) -> WorkloadSpec:
+    """Diurnal streaming churn served through the frontend pool."""
+    # The diurnal_streaming shape, but the WatchCapacity leg rides the
+    # serving-plane pool: 2 listener workers over shared-memory push
+    # rings, streams spread across 4 shards (stable client hash), the
+    # tick-edge pump standing in for the workers' poll loops. The
+    # frontend gates require the pool to have visibly carried the
+    # stream traffic AND still be holding every stream at run end —
+    # a silent fall-back to the in-process path fails the scenario.
+    ticks = ticks or 30
+    streams = _pop(scale, 4)
+    return WorkloadSpec.make(
+        "diurnal_streaming_pooled", ticks, seed=seed,
+        capacity=300.0 * scale,
+        stream_clients=[(1, 20.0)] * streams,
+        base_clients=[(1, 10.0)] * _pop(scale, 2),
+        frontend_workers=2, stream_shards=4,
+        generators=[
+            G(
+                "diurnal", curve="0:2,10:6,20:2", period=20.0,
+                jitter=0.15, bands=[[0, 1.0]], wants=6.0,
+                lifetime_ticks=5, max_population=_pop(scale, 50),
+            ),
+        ],
+        gates={
+            "stream_pushes": float(streams),
+            "satisfaction": 0.9,
+            "frontend_frames": float(streams),
+            "frontend_held": float(streams),
+        },
+    )
+
+
 def flash_crowd_predictive(scale: float = 1.0, seed: int = 0,
                            ticks: Optional[int] = None) -> WorkloadSpec:
     """Seasonal forecaster primes AIMD before each repeating crowd."""
@@ -266,7 +301,7 @@ SCENARIOS: Dict[str, Callable[..., WorkloadSpec]] = {
     for fn in (
         diurnal, flash_crowd, rolling_deploy, multi_region,
         elastic_preempt, flash_crowd_federated, diurnal_streaming,
-        flash_crowd_predictive,
+        diurnal_streaming_pooled, flash_crowd_predictive,
     )
 }
 

@@ -85,6 +85,15 @@ class WorkloadSpec:
     base_clients: tuple = ()
     # Streaming clients as (band, wants) pairs (WatchCapacity leg).
     stream_clients: tuple = ()
+    # Serving-plane pool (doorman_tpu/frontend/): N listener workers
+    # fanning WatchCapacity pushes through per-worker shared-memory
+    # rings; 0 keeps the single-process in-server streaming path.
+    frontend_workers: int = 0
+    # Per-worker ring capacity in bytes (only read when workers > 0).
+    frontend_ring: int = 1 << 20
+    # Stream-shard count (stable client hash -> shard -> worker); >1 is
+    # what spreads streams across the pool's workers.
+    stream_shards: int = 1
     # -- load shapes ----------------------------------------------------
     generators: Tuple[GeneratorSpec, ...] = ()
     # -- predictive admission -------------------------------------------

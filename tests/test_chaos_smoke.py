@@ -324,3 +324,94 @@ def test_client_storm_slo_embeds_per_band_tallies(verdicts):
         key = f"GetCapacity/{band}"
         assert adm[key]["admitted"] == counts["admitted"]
         assert adm[key]["shed"] == counts["shed"]
+
+
+def test_frontend_worker_crash_resets_to_redirect_and_reestablishes(verdicts):
+    """The serving-plane crash arc: the dead worker's streams end with
+    a mastership redirect THE SAME TICK (never a silent lapse), their
+    clients re-establish on the survivor the next tick, the streams the
+    survivor held never notice, and the worker restarts at heal — with
+    the full population re-homed and held by the end of the run."""
+    v = verdicts["frontend_worker_crash"]
+    crash = next(e for e in v["event_log"] if e[1] == "worker_crash")
+    tick, _, server, worker, dropped = crash
+    assert server == "s0" and worker == 0
+    assert dropped > 0, "the crash must actually drop streams"
+    # Every dropped stream's client saw the redirect the crash tick...
+    redirects = [
+        e for e in v["event_log"]
+        if e[1] == "stream" and e[0] == tick and "redirect" in e[3]
+    ]
+    assert len(redirects) == dropped
+    # ...and re-established the very next tick (onto the survivor —
+    # pushes resume before the dead worker returns).
+    reestablished = {
+        e[2] for e in v["event_log"]
+        if e[1] == "stream" and tick < e[0] < v["heal_tick"]
+        and "establish" in e[3]
+    }
+    assert reestablished == {e[2] for e in redirects}
+    restore = next(e for e in v["event_log"] if e[1] == "worker_restore")
+    assert restore[0] == v["heal_tick"] and restore[3] == worker
+    fe = v["frontend"]["s0"]
+    assert fe["crashes"] == 1 and fe["restores"] == 1
+    # Everyone is held again at the end; both workers live.
+    assert fe["held"] == get_plan("frontend_worker_crash").setup["streams"]
+    assert fe["live"] == [0, 1]
+    # The restarted worker's reader resumed at the ring head: no frame
+    # replay (a fresh cursor reports zero laps and no backlog debt).
+    w0 = fe["per_worker"][0]
+    assert w0["reader"]["laps"] == 0
+
+
+def test_frontend_ring_stall_laps_loudly_on_resume(verdicts):
+    """The serving-plane stall arc: a frozen pump over a tiny ring is
+    LAPPED by the tick edge (appends never block); the resume pump
+    reports the lap and resets every held stream to a redirect — the
+    loud failure mode — after which clients re-establish and the pool
+    returns to steady state."""
+    v = verdicts["frontend_ring_stall"]
+    stall = next(e for e in v["event_log"] if e[1] == "ring_stall")
+    resume = next(e for e in v["event_log"] if e[1] == "ring_resume")
+    assert stall[0] == get_plan("frontend_ring_stall").events[0].at_tick
+    assert resume[0] == v["heal_tick"]
+    # The resume pump surfaced the lap...
+    pump = next(
+        e for e in v["event_log"]
+        if e[1] == "frontend_pump" and e[0] == resume[0]
+    )
+    assert pump[4] >= 1  # lapped
+    # ...which reset the stalled worker's streams to redirects that
+    # tick; the survivor's streams saw no redirect the whole run.
+    redirected = {
+        e[2] for e in v["event_log"]
+        if e[1] == "stream" and e[0] == resume[0] and "redirect" in e[3]
+    }
+    assert redirected
+    all_redirected = {
+        e[2] for e in v["event_log"]
+        if e[1] == "stream" and "redirect" in e[3]
+    }
+    assert all_redirected == redirected, (
+        "streams outside the stalled worker were reset"
+    )
+    # Steady state after re-establishment: no redirects in the final
+    # quarter of the run (the oscillation guard — the ring must hold a
+    # healthy tick's traffic).
+    last_q = v["ticks"] - (v["ticks"] - v["heal_tick"]) // 2
+    assert not [
+        e for e in v["event_log"]
+        if e[1] == "stream" and e[0] >= last_q and "redirect" in e[3]
+    ]
+    fe = v["frontend"]["s0"]
+    assert fe["held"] == get_plan("frontend_ring_stall").setup["streams"]
+    assert fe["stalled"] == []
+
+
+def test_frontend_crash_log_is_deterministic(verdicts):
+    """The serving-plane arcs replay byte-identically: rings, pumps,
+    crash/restore, redirects and re-establishments are all driven on
+    the virtual clock."""
+    again = run_plan("frontend_worker_crash")
+    assert again["log_sha256"] == verdicts["frontend_worker_crash"]["log_sha256"]
+    assert again["frontend"] == verdicts["frontend_worker_crash"]["frontend"]

@@ -253,3 +253,62 @@ def test_storm_rejects_rate_curve_with_streams():
             "127.0.0.1:1", workers=1, duration=0.1, stream=True,
             rate_curve="0:10,1:10",
         ))
+
+
+def test_storm_parser_accepts_procs_flag():
+    from doorman_tpu.loadtest.storm import make_parser
+
+    args = make_parser().parse_args(["--procs", "4"])
+    assert args.procs == 4
+    assert make_parser().parse_args([]).procs == 1
+
+
+def test_storm_merge_sums_counters_and_keeps_exact_tails():
+    from doorman_tpu.loadtest.storm import (
+        merge_storm_results,
+        percentile,
+    )
+
+    def part(ok, shed, band_lat, dur):
+        return {
+            "ok": ok, "shed": shed, "errors": 0, "redirects": 1,
+            "ok_by_band": {0: ok}, "shed_by_band": {0: shed},
+            "workers": 2, "duration_s": dur,
+            "latencies_sorted": sorted(band_lat),
+            "latencies_sorted_by_band": {0: sorted(band_lat)},
+        }
+
+    a = part(3, 1, [0.010, 0.020, 0.030], 5.0)
+    b = part(5, 2, [0.001, 0.002, 0.003, 0.004, 0.005], 5.2)
+    merged = merge_storm_results([a, b])
+    assert merged["procs"] == 2 and merged["workers"] == 4
+    assert merged["ok"] == 8 and merged["shed"] == 3
+    assert merged["redirects"] == 2
+    assert merged["ok_by_band"] == {0: 8}
+    # The procs ran concurrently: rates divide by the slowest child's
+    # wall, not the sum of the two.
+    assert merged["duration_s"] == 5.2
+    assert merged["goodput_qps"] == round(8 / 5.2, 1)
+    # Percentiles come from the CONCATENATED population — exact, not
+    # an average of the per-proc percentiles.
+    population = sorted(
+        a["latencies_sorted"] + b["latencies_sorted"]
+    )
+    assert merged["p99_s"] == round(percentile(population, 0.99), 6)
+    assert merged["p50_s"] == round(percentile(population, 0.50), 6)
+    assert merged["p99_s_by_band"][0] == merged["p99_s"]
+    with pytest.raises(ValueError, match="no storm results"):
+        merge_storm_results([])
+
+
+def test_storm_procs_single_proc_falls_through_inline():
+    # procs=1 takes the in-process path (no spawn): against a dead
+    # address everything errors but the report shape is the merged one.
+    from doorman_tpu.loadtest.storm import run_storm_procs
+
+    out = run_storm_procs(
+        "127.0.0.1:1", procs=1, workers=2, duration=0.2,
+        rpc_timeout=0.05,
+    )
+    assert out["procs"] == 1 and out["workers"] == 2
+    assert out["ok"] == 0 and out["errors"] > 0

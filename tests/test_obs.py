@@ -171,6 +171,69 @@ def test_instrumented_server_and_debug_pages():
     asyncio.run(body())
 
 
+def test_debug_frontend_page_renders_inline_pool():
+    """/debug/frontend renders the serving-plane pool's liveness, held
+    streams, and per-worker pump counters; ?format=json mirrors the
+    pool's status dict. Servers without a pool say so instead of 500."""
+    import json
+
+    from doorman_tpu.proto import doorman_stream_pb2 as spb
+
+    async def body():
+        server = CapacityServer(
+            "fe-obs", TrivialElection(), minimum_refresh_interval=0.0,
+            mode="immediate", stream_push=True, stream_shards=4,
+        )
+        pool = server.attach_frontend(2)
+        port = await server.start(0, host="127.0.0.1")
+        await server.load_config(parse_yaml_config(CONFIG))
+        await asyncio.sleep(0)
+        server.current_master = f"127.0.0.1:{port}"
+
+        bare = CapacityServer(
+            "fe-none", TrivialElection(), minimum_refresh_interval=0.0
+        )
+
+        req = spb.WatchCapacityRequest(client_id="w1")
+        rr = req.resource.add()
+        rr.resource_id = "r0"
+        rr.wants = 5.0
+        sub = server._streams.subscribe(req)
+        server._stream_match_add(sub)
+        pool.pump_all()
+
+        debug = DebugServer(host="127.0.0.1", registry=Registry())
+        loop = asyncio.get_running_loop()
+        debug.add_server(server, loop)
+        debug.add_server(bare, loop)
+        dport = debug.start()
+        try:
+            status, page = await loop.run_in_executor(
+                None, fetch, dport, "/debug/frontend"
+            )
+            assert status == 200
+            assert "mode: inline" in page
+            assert "workers live: 2/2" in page
+            assert "held: 1" in page
+            assert "no frontend pool attached" in page  # fe-none
+            status, text = await loop.run_in_executor(
+                None, fetch, dport, "/debug/frontend?format=json"
+            )
+            assert status == 200
+            st = json.loads(text)
+            assert st["fe-none"] is None
+            assert st["fe-obs"]["held"] == 1
+            assert st["fe-obs"]["live"] == [0, 1]
+            assert sum(
+                w["frames"] for w in st["fe-obs"]["per_worker"]
+            ) >= 1
+        finally:
+            debug.stop()
+            await server.stop()
+
+    asyncio.run(body())
+
+
 def test_batch_tick_profiler_trace(tmp_path):
     """--profile-dir writes a JAX profiler trace of the first ticks."""
     import jax

@@ -150,6 +150,27 @@ def test_flash_crowd_gates_and_flightrec_dump_on_failure():
     assert bad["flightrec_dump"]["records"], bad["flightrec_dump"]
 
 
+def test_pooled_streaming_scenario_carries_traffic_and_replays():
+    v = run_scenario("diurnal_streaming_pooled", scale=0.5, seed=3,
+                     ticks=18)
+    assert v["ok"], v["slo"]
+    # The serving plane visibly carried the stream traffic: the pool
+    # pumped ring frames AND still holds every stream at run end (a
+    # silent fall-back to the in-process path would zero both).
+    assert v["summary"]["frontend_frames"] > 0
+    assert v["summary"]["frontend_held"] == 2.0
+    assert v["summary"]["stream_pushes"] > 0
+    fe = v["frontend"]["s0"]
+    assert fe["live"] == [0, 1] and fe["crashes"] == 0
+    # No pump anomalies on a healthy run: laps/corrupt frames would
+    # get their own frontend_pump log entry.
+    assert not any(row[1] == "frontend_pump" for row in v["event_log"])
+    # Byte-stable replay holds with the pool in the loop.
+    w = run_scenario("diurnal_streaming_pooled", scale=0.5, seed=3,
+                     ticks=18)
+    assert w["log_sha256"] == v["log_sha256"]
+
+
 # ----------------------------------------------------------------------
 # Predictive head-to-head
 # ----------------------------------------------------------------------

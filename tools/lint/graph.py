@@ -43,6 +43,7 @@ from tools.lint.core import FileContext, qualname
 # chaos-reachable; nothing else is. doc/lint.md "Registry derivation".
 CHAOS_ROOTS = (
     "doorman_tpu/chaos/",
+    "doorman_tpu/frontend/",
     "doorman_tpu/server/",
     "doorman_tpu/sim/",
 )
