@@ -128,13 +128,9 @@ class FrontendControl:
             reply = await ramp.submit(establish)
         else:
             reply = establish()
-        if worker >= 0 and "shed" in reply and (
-            server._admission is not None
-        ):
-            server._admission.absorb_worker_tallies(
-                worker,
-                {f"WatchCapacity/{band}": {"shed": 1}},
-            )
+        # Shed attribution rides the WORKER's heartbeat delta (it
+        # tallies the shed reply in _watch) — absorbing it here too
+        # would double-count it in Admission.worker_tallies.
         return json.dumps(reply).encode()
 
     async def Drop(self, request_bytes: bytes, context) -> bytes:

@@ -1,12 +1,20 @@
 """The shared-memory push ring: seq-stamped, checksummed frames from
 one writer (the device-owning tick process) to per-worker readers.
 
-Layout: a 16-byte control block, then `capacity` data bytes.
+Layout: a 32-byte control block, then `capacity` data bytes.
 
-    control:  <write_pos:u64><frames:u64>      (little-endian)
+    control:  <version:u64><write_pos:u64><frames:u64><pad:u64>
     frame:    <magic:u32><shard:u16><kind:u8><flags:u8>
               <length:u32><stream_id:u64><seq:u64><crc:u32>
-              <payload: length bytes>
+              <payload: length bytes>      (all little-endian)
+
+The control block is a seqlock: Python writes it as a multi-byte
+memcpy over shared memory, which is NOT atomic across processes, so a
+reader could otherwise observe a torn `write_pos` mid-update — garbage
+that would trigger a spurious lap and a mass stream reset. The writer
+bumps `version` to odd before touching the fields and to even after;
+a reader retries until it sees the same even version on both sides of
+its copy, so every control read is a consistent snapshot.
 
 `write_pos` is the writer's LOGICAL position — total bytes ever
 appended, never wrapped; the physical offset of any logical position is
@@ -71,10 +79,12 @@ KIND_PUSH = 1
 KIND_TERMINAL = 2
 KIND_BEAT = 3
 
-_CTRL = struct.Struct("<QQ")
+_CTRL_VER = struct.Struct("<Q")
+_CTRL_FIELDS = struct.Struct("<QQ")
 _HEAD = struct.Struct("<IHBBIQQI")
-CTRL_SIZE = _CTRL.size  # 16
+CTRL_SIZE = 32  # version + write_pos + frames, padded
 HEADER_SIZE = _HEAD.size  # 32
+_MASK64 = (1 << 64) - 1
 
 
 class Frame(NamedTuple):
@@ -127,10 +137,26 @@ class Ring:
     # -- control block -------------------------------------------------
 
     def read_control(self) -> tuple:
-        return _CTRL.unpack_from(self.buf, 0)
+        """Seqlock read: retry until the version is even (no update in
+        flight) and unchanged across the field copy (module
+        docstring)."""
+        for _ in range(64):
+            v1 = _CTRL_VER.unpack_from(self.buf, 0)[0]
+            fields = _CTRL_FIELDS.unpack_from(self.buf, 8)
+            if v1 & 1:
+                continue
+            if _CTRL_VER.unpack_from(self.buf, 0)[0] == v1:
+                return fields
+        # Only reachable if the writer died MID-update (an odd version
+        # that never clears): surface the last copy — the crc and lap
+        # checks downstream keep a torn value loud, not silent.
+        return fields
 
     def write_control(self, write_pos: int, frames: int) -> None:
-        _CTRL.pack_into(self.buf, 0, write_pos, frames)
+        v = _CTRL_VER.unpack_from(self.buf, 0)[0]
+        _CTRL_VER.pack_into(self.buf, 0, (v + 1) & _MASK64)  # odd: busy
+        _CTRL_FIELDS.pack_into(self.buf, 8, write_pos, frames)
+        _CTRL_VER.pack_into(self.buf, 0, (v + 2) & _MASK64)  # published
 
     # -- wrapped data access -------------------------------------------
 

@@ -45,10 +45,13 @@ __all__ = ["WorkerCore", "run_worker"]
 
 # Frames addressed to a stream the worker has not registered yet: the
 # establishment snapshot can land on the ring before the Establish
-# reply reaches the worker. Parked frames flush at registration;
-# the dict is bounded — a flood of frames for streams that never
-# register (e.g. addressed to a predecessor worker's table) must not
-# grow worker memory.
+# reply reaches the worker. Parked frames flush at registration; the
+# buffer is bounded AND self-cleaning — frames for streams that never
+# register (dropped between publish and the Drop RPC, cancelled
+# establishes, a predecessor worker's table) expire after one stall
+# margin, and when the global limit is hit the oldest parked stream is
+# evicted to make room, so transient orphans can never permanently
+# poison the buffer for the stream registering next.
 PARK_LIMIT = 1024
 
 # A stream is stalled when `margin` silent-refresh beats pass without
@@ -82,7 +85,11 @@ class WorkerCore:
         # stream_id -> opaque handle (inline: the Subscription; real:
         # the stream's local outbound queue).
         self.streams: Dict[int, object] = {}
+        # Parked frames by stream, with the first-parked timestamp per
+        # stream (set once, so dict insertion order IS age order).
         self._parked: Dict[int, List[tuple]] = {}
+        self._park_ts: Dict[int, float] = {}
+        self._parked_total = 0
         # The deadline wheel: bucket -> [stream_id]; per-stream armed
         # deadlines live in _deadline (lazy deletion, like the
         # StreamShard wheel — re-arming just inserts again).
@@ -94,6 +101,7 @@ class WorkerCore:
         self.beats = 0
         self.parked_frames = 0
         self.parked_dropped = 0
+        self.parked_expired = 0
         self.stalls = 0
         self.desyncs = 0
         self.frames = 0
@@ -106,13 +114,13 @@ class WorkerCore:
     def register(self, stream_id: int, handle: object, now: float) -> None:
         self.streams[stream_id] = handle
         self._arm(stream_id, now)
-        for kind, payload in self._parked.pop(stream_id, ()):  # flush
+        for kind, payload in self._take_parked(stream_id):  # flush
             self._dispatch(stream_id, handle, kind, payload, now)
 
     def drop(self, stream_id: int) -> None:
         self.streams.pop(stream_id, None)
         self._deadline.pop(stream_id, None)
-        self._parked.pop(stream_id, None)
+        self._take_parked(stream_id)
 
     # -- the deadline wheel --------------------------------------------
 
@@ -126,7 +134,9 @@ class WorkerCore:
     def check_deadlines(self, now: float) -> int:
         """Pop due wheel buckets; a stream whose armed deadline lapsed
         saw NO frame for a full margin — reset it loudly. Returns
-        streams stalled."""
+        streams stalled. Also sweeps expired parked frames: the park
+        TTL is the same margin."""
+        self._sweep_parked(now)
         if not self._wheel:
             return 0
         nb = int(now // self._wheel_g)
@@ -171,7 +181,7 @@ class WorkerCore:
                 continue
             handle = self.streams.get(f.stream_id)
             if handle is None:
-                self._park(f.stream_id, f.kind, f.payload)
+                self._park(f.stream_id, f.kind, f.payload, now)
                 continue
             self._dispatch(f.stream_id, handle, f.kind, f.payload, now)
         if res.lapped or res.corrupt:
@@ -198,15 +208,41 @@ class WorkerCore:
             self.drop(stream_id)
             self._terminal(stream_id, handle, payload)
 
-    def _park(self, stream_id: int, kind: int, payload: bytes) -> None:
+    def _park(self, stream_id: int, kind: int, payload: bytes,
+              now: float) -> None:
         if kind == KIND_BEAT:
             return
-        total = sum(len(v) for v in self._parked.values())
-        if total >= self._park_limit:
+        if self._park_limit <= 0:
             self.parked_dropped += 1
             return
+        while self._parked_total >= self._park_limit and self._parked:
+            # Full: evict the oldest parked STREAM wholesale — its
+            # registration is the furthest overdue, so it is the most
+            # likely orphan; the frame arriving now must still park.
+            oldest = next(iter(self._park_ts))
+            self.parked_dropped += len(self._take_parked(oldest))
         self.parked_frames += 1
+        self._parked_total += 1
+        self._park_ts.setdefault(stream_id, now)
         self._parked.setdefault(stream_id, []).append((kind, payload))
+
+    def _take_parked(self, stream_id: int) -> List[tuple]:
+        entries = self._parked.pop(stream_id, [])
+        self._park_ts.pop(stream_id, None)
+        self._parked_total -= len(entries)
+        return entries
+
+    def _sweep_parked(self, now: float) -> None:
+        """Reclaim parked streams older than one stall margin: the
+        establishment reply rides the same backend channel as the
+        frames, so a stream that has not registered within a full
+        margin of its first parked frame never will (dropped between
+        publish and the Drop RPC, or a cancelled establish)."""
+        while self._park_ts:
+            oldest = next(iter(self._park_ts))
+            if self._park_ts[oldest] + self._margin > now:
+                break  # age order: everything later is younger
+            self.parked_expired += len(self._take_parked(oldest))
 
     def status(self) -> dict:
         return {
@@ -219,7 +255,9 @@ class WorkerCore:
             "stalls": self.stalls,
             "desyncs": self.desyncs,
             "parked": self.parked_frames,
+            "parked_live": self._parked_total,
             "parked_dropped": self.parked_dropped,
+            "parked_expired": self.parked_expired,
             "reader": self.reader.status(),
         }
 
@@ -470,14 +508,23 @@ async def _worker_serve(
             await asyncio.sleep(poll_interval)
 
     async def heartbeat_loop():
+        # Tally deltas move to `pending` before each send and clear
+        # only after the RPC succeeds: a heartbeat that fails (tick
+        # process briefly unavailable) retries its deltas next beat
+        # instead of losing them from the per-worker attribution.
+        pending: Dict[str, Dict[str, int]] = {}
         while True:
             await asyncio.sleep(heartbeat_interval)
+            for key, outcomes in tallies.items():
+                slot = pending.setdefault(key, {})
+                for outcome, n in outcomes.items():
+                    slot[outcome] = slot.get(outcome, 0) + n
+            tallies.clear()
             body = json.dumps({
                 "worker": index,
                 "held": core.held(),
-                "tallies": dict(tallies),
+                "tallies": pending,
             }).encode()
-            tallies.clear()
             recorder.record(
                 held=core.held(), frames=core.frames,
                 pushes=core.pushes, stalls=core.stalls,
@@ -485,7 +532,12 @@ async def _worker_serve(
             try:
                 await heartbeat_rpc(body, metadata=_worker_md)
             except grpc.aio.AioRpcError:
-                log.warning("worker %d: heartbeat failed", index)
+                log.warning(
+                    "worker %d: heartbeat failed (tallies held for "
+                    "retry)", index,
+                )
+            else:
+                pending.clear()
 
     tasks = [
         loop.create_task(pump_loop()),

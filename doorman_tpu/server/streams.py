@@ -681,6 +681,11 @@ class StreamRegistry:
                 self._shards[sub.shard].terminate(sub, mastership)
                 dropped += 1
             self.unsubscribe(sub)
+            # No handler finally / Drop RPC runs for a crashed worker:
+            # release the device-matcher slot here or it leaks
+            # (idempotent — a stream whose handler does come back
+            # around just no-ops).
+            self._server._stream_match_remove(sub)
         if dropped:
             log.info(
                 "%s: frontend worker %d lost — dropped %d stream(s) "

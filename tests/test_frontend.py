@@ -181,6 +181,29 @@ class TestRing:
         with pytest.raises(ValueError):
             w.append(0, KIND_PUSH, 1, b"x" * 128)
 
+    def test_control_block_seqlock_roundtrip(self):
+        """The control block is a seqlock: writes land between an
+        odd/even version bump, reads see exactly what was published
+        and the version rests even."""
+        import struct
+
+        ring = Ring.in_memory(512)
+        ring.write_control(123, 7)
+        assert ring.read_control() == (123, 7)
+        ver = struct.unpack_from("<Q", ring.buf, 0)[0]
+        assert ver == 2  # one publish: +1 busy, +1 published
+
+    def test_control_read_survives_writer_death_mid_update(self):
+        """A version stuck odd (writer died mid-update) must not hang
+        the reader: the bounded retry falls through with the last copy
+        — the crc/lap checks downstream keep it loud."""
+        import struct
+
+        ring = Ring.in_memory(512)
+        ring.write_control(100, 1)
+        struct.pack_into("<Q", ring.buf, 0, 5)  # odd, never clears
+        assert ring.read_control() == (100, 1)
+
     def test_shared_memory_backing(self):
         """The same framing over a named SharedMemory block: writer in
         one mapping, reader attached through a second mapping."""
@@ -266,7 +289,10 @@ class TestWorkerCore:
         core.pump(0.0)
         assert events[-1] == ("push", 7, b"late")
 
-    def test_parking_is_bounded(self):
+    def test_parking_is_bounded_evicts_oldest(self):
+        """A full park buffer evicts the OLDEST parked stream — its
+        registration is the furthest overdue — so the frame arriving
+        now (the stream registering next) still parks."""
         ring = Ring.in_memory(1 << 16)
         w = RingWriter(ring)
         events = []
@@ -274,8 +300,31 @@ class TestWorkerCore:
         for i in range(10):
             w.append(0, KIND_PUSH, 100 + i, b"x")
         core.pump(0.0)
-        assert core.parked_frames == 4
-        assert core.parked_dropped == 6
+        assert core.parked_frames == 10  # every frame parked on arrival
+        assert core.parked_dropped == 6  # the 6 oldest streams evicted
+        core.register(100, object(), 0.0)  # evicted: nothing to flush
+        assert events == []
+        core.register(109, object(), 0.0)  # newest survived
+        assert events == [("push", 109, b"x")]
+
+    def test_parked_orphans_expire_after_margin(self):
+        """Frames for a stream that never registers (dropped between
+        publish and the Drop RPC, cancelled establish) expire after one
+        stall margin — orphans cannot permanently pin the bounded park
+        buffer toward PARK_LIMIT."""
+        ring = Ring.in_memory(1024)
+        w = RingWriter(ring)
+        events = []
+        core = _make_core(ring, events, tick_interval=1.0,
+                          stall_margin=3.0)
+        w.append(0, KIND_PUSH, 7, b"orphan")
+        core.pump(0.0)
+        core.check_deadlines(2.9)
+        assert core.parked_expired == 0  # inside the margin
+        core.check_deadlines(3.1)
+        assert core.parked_expired == 1  # reclaimed
+        core.register(7, object(), 3.1)  # late registration: no flush
+        assert events == []
 
     def test_deadline_wheel_resets_silent_streams(self):
         """No frames AND no beats for a full margin: every held stream
