@@ -3178,6 +3178,134 @@ def bench_workload_scenarios() -> None:
         )
 
 
+# workload_population_scaling: the array-backed vector population
+# engine (ISSUE 19). Resident-population tiers with CONSTANT due
+# refreshes per tick (refresh_spread scales with the tier), so the
+# per-tick driver wall measures cost in TOTAL resident clients — the
+# parked-rows-cost-nothing claim. The SLO floor is the log-log
+# exponent < 0.3 (obs.slo.population_scaling_verdict).
+POPSCALE_TIERS = (1_000, 10_000, 100_000, 1_000_000)
+POPSCALE_DUE_PER_TICK = 500
+POPSCALE_TICKS = 12
+POPSCALE_WARM_TICKS = 2
+POPSCALE_TIER_BUDGET_SECONDS = 300.0
+
+
+def bench_workload_population_scaling() -> None:
+    """Per-tick vector-population driver wall across resident-client
+    tiers (1k -> 1M), constant due-set per tick.
+
+    Per tier: a single-server workload spec parks N clients as compact
+    base_population rows on the vector engine with refresh_spread =
+    N / 500, so every tick refreshes ~500 due rows through the grouped
+    decide seam while the resident arrays grow three orders of
+    magnitude. No admission, no RTT model, leases sized past a full
+    wheel lap — the measured wall is the driver's tick pass alone
+    (population.step_refresh), warm ticks excluded. The emitted value
+    is the log-log exponent of mean per-tick driver wall vs resident
+    population; < 0.3 is the sublinearity SLO floor (flat is the
+    design point — the due set is constant by construction). A tier
+    that cannot finish inside its budget degrades the row to the
+    achieved tiers (diagnostic-not-row below two tiers)."""
+    import asyncio
+
+    from doorman_tpu import native as _native
+    from doorman_tpu.obs import slo as slo_mod
+    from doorman_tpu.workload.harness import WorkloadRunner
+    from doorman_tpu.workload.spec import WorkloadSpec
+
+    def tier_spec(n: int) -> WorkloadSpec:
+        spread = max(1, n // POPSCALE_DUE_PER_TICK)
+        return WorkloadSpec.make(
+            f"popscale_{n}", POPSCALE_TICKS, seed=0,
+            capacity=float(n),
+            lease_length=4.0 * max(spread, POPSCALE_TICKS),
+            population_engine="vector", refresh_spread=spread,
+            native_store=True,
+            base_population=[[n, 0, 1.0]],
+        )
+
+    async def run_tier(n: int) -> dict:
+        runner = WorkloadRunner(tier_spec(n))
+        t0 = time.monotonic()
+        verdict = await asyncio.wait_for(
+            runner.run(), POPSCALE_TIER_BUDGET_SECONDS
+        )
+        wall = time.monotonic() - t0
+        engine = runner._vector
+        walls = engine.step_walls[POPSCALE_WARM_TICKS:]
+        return {
+            "population": n,
+            "refresh_spread": tier_spec(n).refresh_spread,
+            "driver_tick_ms_mean": round(
+                1000.0 * sum(walls) / len(walls), 4
+            ),
+            "driver_tick_ms_max": round(1000.0 * max(walls), 4),
+            "fast_rows": engine.fast_rows_total,
+            "seq_rows": engine.seq_rows_total,
+            "refresh_ok_ratio": float(
+                verdict["summary"].get("refresh_ok_ratio", 0.0)
+            ),
+            "run_wall_s": round(wall, 3),
+        }
+
+    async def run():
+        import math
+
+        tiers, failures = [], []
+        for n in POPSCALE_TIERS:
+            try:
+                tiers.append(await run_tier(n))
+            except (asyncio.TimeoutError, MemoryError) as exc:
+                failures.append({
+                    "population": n,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+                break
+        if len(tiers) < 2:
+            diagnostic({
+                "diagnostic": "population_scaling_unmeasured",
+                "note": (
+                    "fewer than two population tiers completed; no "
+                    "scaling claim from one point"
+                ),
+                "tiers": tiers,
+                "failures": failures,
+            })
+            return
+        xs = [math.log(t["population"]) for t in tiers]
+        ys = [
+            math.log(max(t["driver_tick_ms_mean"], 1e-4))
+            for t in tiers
+        ]
+        k = len(xs)
+        mx, my = sum(xs) / k, sum(ys) / k
+        exponent = round(
+            sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+            / sum((x - mx) ** 2 for x in xs),
+            4,
+        )
+        verdict = slo_mod.population_scaling_verdict(exponent)
+        emit(
+            {
+                "metric": "workload_population_scaling",
+                "value": exponent,
+                "unit": "exponent",
+                "population_max": tiers[-1]["population"],
+                "due_per_tick": POPSCALE_DUE_PER_TICK,
+                "native_store": _native.native_available(),
+                "driver_tick_ms_at_max": tiers[-1][
+                    "driver_tick_ms_mean"
+                ],
+                "tiers": tiers,
+                "slo": [verdict],
+            },
+            artifact_extra={"failures": failures},
+        )
+
+    asyncio.run(run())
+
+
 def _preseed_artifact() -> None:
     """Load the previous doc/bench_last.json rows so an --only run's
     artifact keeps the stages it did not re-measure."""
@@ -3237,6 +3365,7 @@ if __name__ == "__main__":
         "frontend": bench_server_frontend,
         "federated_roots": bench_server_tick_federated_roots,
         "workload": bench_workload_scenarios,
+        "population_scaling": bench_workload_population_scaling,
         "server_tick": bench_server_tick,
     }
     _ap.add_argument(
@@ -3304,6 +3433,9 @@ if __name__ == "__main__":
             # Closed-loop workload scenarios: SLO-gated verdict rows
             # (no device work; replay-pinned by log_sha256).
             bench_workload_scenarios()
+            # Vector population engine: per-tick driver wall vs
+            # resident population (sublinearity SLO floor < 0.3).
+            bench_workload_population_scaling()
             # The narrow server tick stays LAST: the driver parses the
             # final JSON line as the round's headline metric.
             bench_server_tick()

@@ -44,7 +44,191 @@ from doorman_tpu.proto import doorman_pb2 as pb
 
 log = logging.getLogger(__name__)
 
-__all__ = ["Coalescer", "decide_grouped"]
+__all__ = ["Coalescer", "decide_grouped", "decide_grouped_arrays"]
+
+# Wire algorithm kinds the array pass carries. PROPORTIONAL_SHARE's
+# variants (topup/logutil) resolve to different lane ids in
+# `Resource._decide_kind`, so they fall out of this set automatically;
+# PRIORITY_BANDS / FAIR_SHARE walk the store per request and stay on
+# the sequential path.
+_ARRAY_KINDS = frozenset((
+    int(pb.Algorithm.NO_ALGORITHM),
+    int(pb.Algorithm.STATIC),
+    int(pb.Algorithm.PROPORTIONAL_SHARE),
+))
+
+
+def decide_grouped_arrays(
+    server,
+    resource_id: str,
+    cids,
+    has,
+    wants,
+    priorities,
+    *,
+    old_has,
+    old_wants,
+    new_mask,
+    cid_handles=None,
+    expected_count=None,
+):
+    """Array form of `decide_grouped` for one resource's batch of
+    single-resource requests in arrival order: compute every grant in a
+    vectorized pass, commit them in one bulk store write, and return
+    ``(grants, expiry, refresh_interval, safe, fast_rows)`` float/int
+    arrays in input order — or None when this resource can't take the
+    array path (unsupported algorithm lane, learning mode, persistence
+    journaling per decide, or a store the caller's mirrors don't fully
+    describe via ``expected_count``).
+
+    Exactness argument (the vector population's parity pin): the
+    sequential pass evolves only the store's running aggregates between
+    rows — ``sum_wants``/``sum_has`` by per-row ``+= delta`` and
+    ``count`` by new-client subclients. np.cumsum accumulates strictly
+    left-to-right, so seeding it with the aggregate's starting value
+    reproduces the identical sequence of float additions; each row's
+    grant formula is then evaluated with the scalar algorithm's exact
+    operation order. The one circularity — PROPORTIONAL_SHARE's free
+    clamp reads ``sum_has`` which depends on earlier grants — is
+    resolved by hypothesis: assume no row clamps (the steady state),
+    check ``grant <= free`` elementwise, and on the first violating row
+    commit only the exact prefix before it, finishing the remainder
+    through the sequential `decide_grouped` (so clamped ticks are
+    slower, never wrong).
+
+    ``old_has``/``old_wants``/``new_mask`` are the caller's per-row
+    mirrors of the store rows (exact, because every value they hold
+    came out of this same decide path); ``expected_count`` is the
+    caller's live-lease count — a mismatch with ``store.count`` means a
+    foreign writer shares the store and the array pass stands down.
+    Preconditions the CALLER owns: server is master, no lease in the
+    store is expired (a sequential decide would sweep it), and every
+    row's client already holds a lease iff ``new_mask`` says so.
+    """
+    import numpy as np  # deferred: the RPC path never pays the import
+
+    res = server.get_or_create_resource(resource_id)
+    if (
+        res._decide_kind not in _ARRAY_KINDS
+        or res.in_learning_mode
+        or server._persist is not None
+    ):
+        return None
+    store = res.store
+    if expected_count is not None and store.count != expected_count:
+        return None
+
+    n = len(wants)
+    w = np.ascontiguousarray(wants, np.float64)
+    prio = np.ascontiguousarray(priorities, np.int64)
+    old_h = np.ascontiguousarray(old_has, np.float64)
+    old_w = np.ascontiguousarray(old_wants, np.float64)
+    new = np.ascontiguousarray(new_mask, bool)
+    cap = res.capacity
+    length = res._lease_length
+    interval = res._refresh_interval
+    now = server._clock()
+
+    kind = res._decide_kind
+    if kind == int(pb.Algorithm.NO_ALGORITHM):
+        grants = w.copy()
+        fast_rows = n
+    elif kind == int(pb.Algorithm.STATIC):
+        # STATIC's capacity is per client, not a shared pool: no
+        # cross-row state at all.
+        grants = np.minimum(cap, w)
+        fast_rows = n
+    else:  # PROPORTIONAL_SHARE (scalar.proportional_share)
+        # sum_wants as row i reads it: the starting aggregate plus the
+        # earlier rows' (wants - old.wants) deltas, accumulated in the
+        # same left-to-right order assign() applies them.
+        sw_before = np.cumsum(
+            np.concatenate(([store.sum_wants], (w - old_w)[:-1]))
+        )
+        all_wants = (sw_before - old_w) + w
+        with np.errstate(divide="ignore", invalid="ignore"):
+            # Both branches evaluate everywhere; the overload quotient
+            # is garbage (and discarded) on underloaded rows.
+            grants = np.where(
+                all_wants < cap, w, w * (cap / all_wants)
+            )
+        # sum_has as row i reads it, under the no-clamp hypothesis.
+        sh_before = np.cumsum(
+            np.concatenate(([store.sum_has], (grants - old_h)[:-1]))
+        )
+        free = np.maximum(cap - (sh_before - old_h), 0.0)
+        ok = grants <= free
+        fast_rows = n if bool(ok.all()) else int(np.argmax(~ok))
+        grants = grants[:fast_rows]
+
+    name_of = None
+    if cids is None:
+        # Names are recoverable from the engine's interning table; the
+        # fast path never materializes them.
+        name_of = server._store_engine.client_name
+
+    if fast_rows:
+        bulk_handles = getattr(store, "bulk_assign_handles", None)
+        if cid_handles is not None and bulk_handles is not None:
+            bulk_handles(
+                cid_handles[:fast_rows], length, interval,
+                grants, w[:fast_rows], priority=prio[:fast_rows],
+            )
+        else:
+            names = (
+                cids[:fast_rows] if cids is not None
+                else [name_of(int(h)) for h in cid_handles[:fast_rows]]
+            )
+            store.bulk_assign(
+                names, length, interval, grants, w[:fast_rows],
+                priority=prio[:fast_rows],
+            )
+
+    out_grants = np.empty(n, np.float64)
+    out_expiry = np.empty(n, np.float64)
+    out_safe = np.empty(n, np.float64)
+    out_refresh = np.full(n, interval, np.float64)
+    out_grants[:fast_rows] = grants
+    out_expiry[:fast_rows] = now + length
+
+    # safe_capacity immediately after each row's assign (where the
+    # per-request path computes it): configured value, or capacity over
+    # the subclient count — which moves only when a NEW client lands.
+    if res.template.HasField("safe_capacity"):
+        out_safe[:] = res.template.safe_capacity
+    else:
+        count_after = store.count  # already includes every bulk row
+        if fast_rows:
+            # Rewind to the count each row observed: start minus the
+            # rows after it.
+            new_cum = np.cumsum(new[:fast_rows].astype(np.int64))
+            start = count_after - (
+                int(new_cum[-1]) if fast_rows else 0
+            )
+            counts = np.maximum(start + new_cum, 1)
+            out_safe[:fast_rows] = res.template.capacity / counts
+
+    if fast_rows < n:
+        work = []
+        for i in range(fast_rows, n):
+            name = (
+                cids[i] if cids is not None
+                else name_of(int(cid_handles[i]))
+            )
+            work.append((resource_id, Request(
+                name, float(has[i]), float(w[i]), 1,
+                priority=int(prio[i]),
+            )))
+        for j, (lease, _res, safe) in enumerate(
+            decide_grouped(server, work)
+        ):
+            i = fast_rows + j
+            out_grants[i] = lease.has
+            out_expiry[i] = lease.expiry
+            out_refresh[i] = lease.refresh_interval
+            out_safe[i] = safe
+
+    return out_grants, out_expiry, out_refresh, out_safe, fast_rows
 
 
 def decide_grouped(server, work: List[Tuple[str, Request]]) -> List[tuple]:

@@ -44,6 +44,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="write the run's flight-recorder dump (the "
                         "gate-failure dump when one fired, else "
                         "nothing) to this path")
+    p.add_argument("--history-dir", default="",
+                   help="durable per-tick history directory: prior "
+                        "runs' records warm-start a predictive "
+                        "scenario's forecaster (bit-identical to "
+                        "having observed them live), and this run's "
+                        "records are appended for the next one")
     return p
 
 
@@ -59,7 +65,15 @@ def run(args: argparse.Namespace) -> int:
     verdict = scen_mod.run_scenario(
         args.scenario, scale=args.scale, seed=args.seed,
         ticks=args.ticks or None,
+        history_dir=args.history_dir or None,
     )
+    if args.history_dir:
+        print(
+            f"forecaster warm-started from "
+            f"{verdict.get('forecaster_warm_start', 0)} recorded "
+            f"ticks; appended this run to {args.history_dir}",
+            file=sys.stderr,
+        )
     text = json.dumps(verdict, indent=1)
     print(text)
     if args.out:

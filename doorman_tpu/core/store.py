@@ -98,6 +98,30 @@ class LeaseStore:
         self._leases[client] = lease
         return lease
 
+    def bulk_assign(
+        self,
+        clients,
+        lease_length: float,
+        refresh_interval: float,
+        has,
+        wants,
+        subclients=None,
+        priority=None,
+    ) -> None:
+        """assign() per row, in input order (the vector population's
+        grouped-commit path). Same running-sum accumulation order and
+        same clock stamp per row as the equivalent assign loop — the
+        native store implements this contract as one C call."""
+        n = len(clients)
+        subs = subclients if subclients is not None else [1] * n
+        prio = priority if priority is not None else [0] * n
+        for i in range(n):
+            self.assign(
+                clients[i], lease_length, refresh_interval,
+                float(has[i]), float(wants[i]), int(subs[i]),
+                int(prio[i]),
+            )
+
     def regrant(self, client: str, has: float) -> None:
         """Update only the granted capacity of an existing lease — the
         batched tick's write-back. Expiry and refresh are NOT touched:

@@ -29,6 +29,11 @@ hold 100k live streams with a few dozen tasks and channels instead of
 one task + channel per stream, which is what lets a single storm
 process exercise the sharded fan-out at its design scale.
 
+``--record out.jsonl`` captures every poll request's start as an
+arrival event (tick, relative time, band, wants) — the workload
+harness's ``trace`` generator replays the captured log as a
+deterministic arrival schedule on the virtual clock (doc/workload.md).
+
 ``--procs P`` splits the worker population over P OS processes (spawn
 context), each with its own event loop, gRPC channels, and seeded RNG
 stream. One asyncio loop tops out near ~570 establishments/s on a
@@ -45,6 +50,7 @@ import argparse
 import asyncio
 import logging
 import random
+import sys
 import time
 from typing import Dict, List, Optional
 
@@ -142,6 +148,7 @@ async def _worker(
     honor_retry_after: bool,
     rpc_timeout: Optional[float],
     pacer: Optional[_Pacer] = None,
+    recorder: Optional[callable] = None,
 ) -> None:
     async with grpc.aio.insecure_channel(addr) as channel:
         stub = CapacityStub(channel)
@@ -153,6 +160,8 @@ async def _worker(
         while time.monotonic() < deadline:
             if pacer is not None and not await pacer.acquire(deadline):
                 return
+            if recorder is not None:
+                recorder(band, wants)
             t0 = time.monotonic()
             try:
                 out = await stub.GetCapacity(request, timeout=rpc_timeout)
@@ -500,6 +509,7 @@ async def run_storm(
     rate_curve: "Optional[RateCurve | str]" = None,
     rate_jitter: float = 0.0,
     index_base: int = 0,
+    record: bool = False,
     _raw: bool = False,
 ) -> Dict:
     """Drive `workers` closed-loop GetCapacity clients (round-robin
@@ -512,7 +522,10 @@ async def run_storm(
     RateCurve or its ``"t:rate,..."`` text form) switches the poll
     storm to open-loop pacing: offered rate follows the piecewise-
     linear schedule (with optional seeded multiplicative
-    ``rate_jitter``) instead of the server's response latency."""
+    ``rate_jitter``) instead of the server's response latency.
+    ``record=True`` captures every poll request's start as an arrival
+    event — ``out["arrivals"]`` rows of ``[t_rel_s, band, wants]`` —
+    the stream the workload harness's ``trace`` generator replays."""
     stats: Dict = {
         "ok": 0, "shed": 0, "errors": 0, "redirects": 0,
         "ok_by_band": {}, "shed_by_band": {}, "latencies": [],
@@ -522,6 +535,12 @@ async def run_storm(
         stats["pushes"] = 0
         stats["resets"] = 0
     rng = random.Random(seed)
+    if record and stream:
+        raise ValueError(
+            "--record captures the poll storm's arrival log; stream "
+            "mode holds long-lived subscriptions and has no per-"
+            "request arrivals to record"
+        )
     pacer: Optional[_Pacer] = None
     if rate_curve is not None:
         if stream:
@@ -538,6 +557,12 @@ async def run_storm(
         ))
     deadline = time.monotonic() + duration
     start = time.monotonic()
+    events: List[tuple] = []
+    recorder = (
+        (lambda band, wants:
+         events.append((time.monotonic() - start, band, wants)))
+        if record else None
+    )
     if pacer is not None:
         pacer.start(deadline)
     if stream and streams_per_worker > 1:
@@ -566,7 +591,7 @@ async def run_storm(
                 index_base + i, addr, resource,
                 bands[(index_base + i) % len(bands)], wants,
                 deadline, stats, random.Random(rng.random()),
-                honor_retry_after, rpc_timeout, pacer,
+                honor_retry_after, rpc_timeout, pacer, recorder,
             )
             for i in range(workers)
         ))
@@ -600,6 +625,10 @@ async def run_storm(
             for band, v in sorted(lat_by_band.items())
         },
     }
+    if record:
+        out["arrivals"] = [
+            [round(t, 6), band, w] for t, band, w in sorted(events)
+        ]
     if _raw:
         # Multi-process merge path: the parent re-derives exact merged
         # percentiles from the children's raw populations.
@@ -637,6 +666,10 @@ def merge_storm_results(parts: List[Dict]) -> Dict:
             "latencies_sorted_by_band", {}
         ).items():
             lat_by_band.setdefault(band, []).extend(values)
+    if "arrivals" in parts[0]:
+        merged["arrivals"] = sorted(
+            row for p in parts for row in p.get("arrivals", ())
+        )
     elapsed = max(p["duration_s"] for p in parts)
     merged.update({
         "procs": len(parts),
@@ -773,6 +806,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "over this many resources (<resource>-<k>) so "
                         "held-stream capacity is measured instead of "
                         "one row's O(n^2) re-grant traffic")
+    p.add_argument("--record", default="",
+                   help="write the storm's arrival log (one JSONL "
+                        "object per poll request: tick, t, band, "
+                        "wants) to this path; the workload harness's "
+                        "'trace' generator replays it "
+                        "(doc/workload.md)")
+    p.add_argument("--record-tick", type=float, default=1.0,
+                   help="tick interval in seconds used to map "
+                        "recorded arrival times onto replayable tick "
+                        "numbers (default 1.0)")
     p.add_argument("--procs", type=int, default=1,
                    help="split the workers over this many OS "
                         "processes (spawn), one event loop each — "
@@ -800,6 +843,7 @@ def main(argv=None) -> None:
         resource_spread=args.resource_spread,
         rate_curve=args.rate_curve or None,
         rate_jitter=args.rate_jitter,
+        record=bool(args.record),
     )
     if args.procs > 1:
         out = run_storm_procs(
@@ -810,6 +854,18 @@ def main(argv=None) -> None:
                                     **kwargs))
     import json
 
+    if args.record:
+        arrivals = out.pop("arrivals", [])
+        tick = max(args.record_tick, 1e-9)
+        with open(args.record, "w") as f:
+            for t, band, wants in arrivals:
+                f.write(json.dumps(
+                    {"tick": int(t // tick), "t": t,
+                     "band": band, "wants": wants},
+                    sort_keys=True,
+                ) + "\n")
+        print(f"recorded {len(arrivals)} arrivals to {args.record}",
+              file=sys.stderr)
     print(json.dumps(out, indent=2, sort_keys=True))
 
 

@@ -774,6 +774,55 @@ class NativeLeaseStore:
                      has=has, wants=wants, subclients=subclients,
                      priority=priority)
 
+    def bulk_assign(
+        self,
+        clients,
+        lease_length: float,
+        refresh_interval: float,
+        has,
+        wants,
+        subclients=None,
+        priority=None,
+    ) -> None:
+        """Same contract as core.store.LeaseStore.bulk_assign — an
+        assign() per row in input order (dm_bulk_assign runs the same
+        per-row upsert, so the running-aggregate accumulation order is
+        identical) — in one C call after interning the client names."""
+        handles = np.fromiter(
+            (self._engine.client_handle(c) for c in clients),
+            np.int64, count=len(clients),
+        )
+        self.bulk_assign_handles(
+            handles, lease_length, refresh_interval, has, wants,
+            subclients, priority,
+        )
+
+    def bulk_assign_handles(
+        self,
+        cid_handles,
+        lease_length: float,
+        refresh_interval: float,
+        has,
+        wants,
+        subclients=None,
+        priority=None,
+    ) -> None:
+        """bulk_assign for callers that already hold engine client
+        handles (the vector population caches them per server), so a
+        steady-state grouped commit is one C call with zero per-row
+        Python work."""
+        n = len(cid_handles)
+        self._engine.bulk_assign(
+            np.full(n, self._rid, np.int32),
+            np.ascontiguousarray(cid_handles, np.int64),
+            np.full(n, self._clock() + lease_length, np.float64),
+            np.full(n, refresh_interval, np.float64),
+            has,
+            wants,
+            np.ones(n, np.int32) if subclients is None else subclients,
+            priority,
+        )
+
     def regrant(self, client: str, has: float) -> None:
         """Update only the granted capacity of an existing lease (see
         core.store.LeaseStore.regrant); expiry/refresh stay put and the

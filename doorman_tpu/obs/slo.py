@@ -51,6 +51,7 @@ __all__ = [
     "bench_verdict",
     "detector_anomaly_spec",
     "histogram_quantile",
+    "population_scaling_verdict",
     "predictive_goodput_verdict",
     "reconvergence_spec",
     "sample_quantile",
@@ -652,6 +653,36 @@ def predictive_goodput_verdict(
         "predictive": round(float(predictive), 6),
         "reactive": round(float(reactive), 6),
     }
+    return verdict
+
+
+def population_scaling_verdict(
+    exponent: float,
+    *,
+    target: float = 0.3,
+    name: str = "workload_population_scaling:sublinear",
+) -> dict:
+    """The vector population engine's driver-cost SLO: the log-log
+    slope of per-tick driver wall time vs population size over the
+    measured tiers must stay under ``target`` (0.3 — near-flat, since
+    the refresh spread holds the due set per tick roughly constant
+    while the resident population grows three orders of magnitude).
+    An exponent of 1.0 is the per-client path's linear walk; the array
+    engine's whole point is that parked rows cost nothing."""
+    spec = SloSpec(
+        name=name,
+        kind="max",
+        target=float(target),
+        source={"type": "scalar", "key": "exponent"},
+        unit="exponent",
+        description=(
+            "log-log slope of per-tick vector-population driver wall "
+            "time vs resident population size"
+        ),
+    )
+    verdict = SloEngine([spec]).evaluate(
+        SloInputs(scalars={"exponent": float(exponent)})
+    )[0]
     return verdict
 
 

@@ -156,6 +156,7 @@ class CapacityServer(CapacityServicer):
         # to the Python store if the C++ build is unavailable).
         self._native_store = False
         self._store_factory = None
+        self._store_engine = None
         if native_store:
             from doorman_tpu import native
 
@@ -568,6 +569,9 @@ class CapacityServer(CapacityServicer):
 
             engine = native.StoreEngine(clock=self._clock)
             self._store_factory = engine.store
+            # The engine itself is exposed for bulk drivers (the vector
+            # population interns client handles against it).
+            self._store_engine = engine
 
     async def _on_is_master(self, is_master: bool) -> None:
         """Mastership changes wipe all lease state; a fresh master starts in
@@ -2100,6 +2104,78 @@ class CapacityServer(CapacityServicer):
             self._persist.record_assign(resource_id, request.client, lease)
         return lease, res
 
+    def decide_bulk(
+        self,
+        resource_id: str,
+        cids,
+        has,
+        wants,
+        priorities,
+        *,
+        old_has,
+        old_wants,
+        new_mask,
+        cid_handles=None,
+        expected_count=None,
+    ):
+        """Bulk refresh entry for array-backed drivers (the vector
+        workload population): decide one resource's batch of
+        single-resource requests in arrival order and return
+        ``(grants, expiry, refresh_interval, safe, fast_rows)`` arrays.
+        Tries the vectorized grouped pass first
+        (`coalesce.decide_grouped_arrays`, grant-exact with the
+        per-request path) and falls back to the sequential
+        `decide_grouped` — identical responses either way, only
+        ``fast_rows`` says how much of the batch went through arrays.
+        Caller preconditions: this server is master, no stored lease is
+        expired, and the mirror arrays describe the store's rows
+        exactly. Must run on the event loop (same discipline as the
+        coalescer's inline window)."""
+        from doorman_tpu.admission import coalesce as coalesce_mod
+        import numpy as np
+
+        try:
+            out = coalesce_mod.decide_grouped_arrays(
+                self, resource_id, cids, has, wants, priorities,
+                old_has=old_has, old_wants=old_wants, new_mask=new_mask,
+                cid_handles=cid_handles, expected_count=expected_count,
+            )
+            if out is None:
+                name_of = (
+                    self._store_engine.client_name
+                    if cids is None else None
+                )
+                work = []
+                for i in range(len(wants)):
+                    name = (
+                        cids[i] if cids is not None
+                        else name_of(int(cid_handles[i]))
+                    )
+                    work.append((resource_id, Request(
+                        name, float(has[i]), float(wants[i]), 1,
+                        priority=int(priorities[i]),
+                    )))
+                decided = coalesce_mod.decide_grouped(self, work)
+                n = len(work)
+                grants = np.empty(n, np.float64)
+                expiry = np.empty(n, np.float64)
+                refresh = np.empty(n, np.float64)
+                safe = np.empty(n, np.float64)
+                for i, (lease, _res, s) in enumerate(decided):
+                    grants[i] = lease.has
+                    expiry[i] = lease.expiry
+                    refresh[i] = lease.refresh_interval
+                    safe[i] = s
+                out = (grants, expiry, refresh, safe, 0)
+        except BaseException:
+            # Same contract as the coalescer's window: a partially
+            # applied batch can't prove staging freshness — drop the
+            # fused cache and re-raise.
+            self._fused_invalidate()
+            raise
+        self._fused_stage({resource_id})
+        return out
+
     # ------------------------------------------------------------------
     # Intermediate-server updater (refresh capacity from parent)
     # ------------------------------------------------------------------
@@ -2470,4 +2546,11 @@ FUSED_TRACKED_WRITERS = frozenset({
     # meaningless across the swap), and a new cache cannot exist until
     # a new solver is built after this method returns.
     "CapacityServer._on_is_master",
+    # The array decide pass (admission/coalesce.decide_grouped_arrays)
+    # commits its grants in one bulk_assign; its sole caller,
+    # CapacityServer.decide_bulk, owns the contract exactly like
+    # Coalescer._decide_batch does for decide_grouped — it calls
+    # _fused_stage after the batch's writes and _fused_invalidate on a
+    # partially-applied batch (the exception path).
+    "decide_grouped_arrays",
 })

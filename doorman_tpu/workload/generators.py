@@ -24,11 +24,16 @@ Registry kinds:
                        accrues with whatever capacity is granted,
                        sustained starvation below `min_wants` preempts
                        (depart + requeue), jobs complete at
-                       `total_work`.
+                       `total_work`;
+  * ``trace``        — replays a recorded arrival log (inline
+                       ``events`` rows or a ``loadtest.storm --record``
+                       JSONL file): real traffic shapes re-run against
+                       the virtual-clock harness, deterministically.
 """
 
 from __future__ import annotations
 
+import json
 import random
 from typing import Dict, List, Optional
 
@@ -287,11 +292,77 @@ class ElasticJobs(Generator):
                 st["starve"] = 0
 
 
+class TraceReplay(Generator):
+    """Replay a recorded arrival trace against the harness.
+
+    Events come inline (``events: [[tick, band, wants], ...]``) or
+    from a JSONL ``path`` — one object per line with ``tick`` plus
+    optional ``band``/``wants``, the format ``loadtest.storm --record``
+    writes — so a storm captured against a real deployment re-runs as
+    a deterministic scenario. Each event arrives one client at its
+    tick; ``lifetime_ticks > 0`` departs it that many ticks later
+    (0: it stays for the run). Draws no randomness: the trace IS the
+    schedule."""
+
+    kind = "trace"
+
+    def __init__(self, params: dict):
+        super().__init__(params)
+        p = self.params
+        self.events = p.get("events")
+        self.path = str(p.get("path", ""))
+        if self.events is None and not self.path:
+            raise ValueError("trace generator needs events or path")
+        self.lifetime_ticks = int(p.get("lifetime_ticks", 0))
+        self.prefix = str(p.get("prefix", "tr"))
+        self._by_tick: Dict[int, List[tuple]] = {}
+        self._serial = 0
+        self._departures: Dict[int, List[str]] = {}
+
+    async def setup(self, harness) -> None:
+        events = self.events
+        if events is None:
+            events = []
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if isinstance(rec, dict):
+                        events.append([
+                            rec["tick"], rec.get("band", 0),
+                            rec.get("wants", 10.0),
+                        ])
+                    else:
+                        events.append(rec)
+        self._by_tick = {}
+        for t, band, wants in events:
+            self._by_tick.setdefault(int(t), []).append(
+                (int(band), float(wants))
+            )
+
+    async def step(self, tick: int, harness) -> None:
+        for cid in self._departures.pop(tick, []):
+            await harness.depart(cid)
+        arrivals = self._by_tick.get(tick, [])
+        for band, wants in arrivals:
+            cid = f"{self.prefix}{self._serial}"
+            self._serial += 1
+            await harness.arrive(cid, band, wants)
+            if self.lifetime_ticks > 0:
+                self._departures.setdefault(
+                    tick + self.lifetime_ticks, []
+                ).append(cid)
+        if arrivals:
+            harness.note(tick, "trace_arrive", len(arrivals))
+
+
 GENERATORS = {
     cls.kind: cls
     for cls in (
         DiurnalArrivals, FlashCrowd, RollingDeploy, MultiRegionRtt,
-        ElasticJobs,
+        ElasticJobs, TraceReplay,
     )
 }
 

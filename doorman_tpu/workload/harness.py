@@ -103,6 +103,21 @@ class WorkloadRunner:
         self.federation = None
         self.clients: Dict[str, Client] = {}
         self.stream_clients: List[Client] = []
+        # Array-backed population engine (spec.population_engine ==
+        # "vector"): macro clients live as numpy rows, refreshed in
+        # batched grouped passes instead of per-client RPCs. None keeps
+        # the per-client reference path.
+        self._vector = None
+        if spec.population_engine == "vector":
+            from doorman_tpu.workload.population import VectorPopulation
+
+            self._vector = VectorPopulation(self)
+        elif spec.population_engine != "clients":
+            raise ValueError(
+                f"unknown population_engine "
+                f"{spec.population_engine!r} "
+                "(known: 'clients', 'vector')"
+            )
         # Serving-plane pools (spec.frontend_workers > 0): one inline
         # frontend pool per server, pumped at the tick edge where a
         # real worker's poll loop would have woken.
@@ -150,7 +165,14 @@ class WorkloadRunner:
     # -- the mutator surface generators drive ---------------------------
 
     def client_ids(self) -> List[str]:
+        if self._vector is not None:
+            return self._vector.client_ids()
         return list(self.clients)
+
+    def _population_count(self) -> int:
+        if self._vector is not None:
+            return self._vector.population()
+        return len(self.clients)
 
     def note(self, tick: int, kind: str, *fields) -> None:
         """One deterministic event-log entry + a trace instant (the
@@ -166,7 +188,17 @@ class WorkloadRunner:
     async def arrive(
         self, cid: str, band: int, wants: float,
         shard: Optional[int] = None,
-    ) -> Client:
+    ) -> Optional[Client]:
+        if self._vector is not None:
+            self._vector.arrive(cid, int(band), float(wants), shard=shard)
+            self._client_shard[cid] = shard
+            self.client_meta.setdefault(cid, {})["band"] = int(band)
+            for g in self.generators:
+                g.on_arrive(cid, self)
+            rtt_ms = self.client_meta.get(cid, {}).get("rtt_ms")
+            if rtt_ms is not None:
+                self._vector.set_rtt(cid, rtt_ms)
+            return None
         if cid in self.clients:
             raise ValueError(f"client id {cid!r} already present")
         addr = self._attach
@@ -187,6 +219,10 @@ class WorkloadRunner:
         return client
 
     async def depart(self, cid: str) -> None:
+        if self._vector is not None:
+            self.client_meta.pop(cid, None)
+            await self._vector.depart(cid)
+            return
         client = self.clients.pop(cid, None)
         if client is None:
             return
@@ -197,6 +233,8 @@ class WorkloadRunner:
             pass
 
     def grant_of(self, cid: str) -> float:
+        if self._vector is not None:
+            return self._vector.grant_of(cid)
         client = self.clients.get(cid)
         if client is None:
             return 0.0
@@ -280,6 +318,7 @@ class WorkloadRunner:
                 minimum_refresh_interval=0.0,
                 clock=self.clock,
                 admission=admission,
+                native_store=bool(spec.native_store),
                 stream_push=bool(spec.stream_clients),
                 stream_shards=int(spec.stream_shards),
                 shard=i if fed else None,
@@ -326,6 +365,29 @@ class WorkloadRunner:
             cid = f"c{i}"
             await self.arrive(cid, int(band), float(wants), shard=shard)
             self._base_ids.append(cid)
+        # Compact base_population rows continue the c-numbering. The
+        # vector engine appends each block as one array extension (its
+        # deadline wheel staggers the initial lease establishment); the
+        # per-client engine expands to real clients one by one.
+        serial = len(spec.base_clients)
+        for count, band, wants in spec.base_population:
+            ids = [f"c{serial + k}" for k in range(int(count))]
+            serial += int(count)
+            if self._vector is not None:
+                self._vector.bulk_arrive(ids, int(band), float(wants))
+                for cid in ids:
+                    self.client_meta.setdefault(cid, {})["band"] = int(
+                        band
+                    )
+                    for g in self.generators:
+                        g.on_arrive(cid, self)
+                    rtt_ms = self.client_meta[cid].get("rtt_ms")
+                    if rtt_ms is not None:
+                        self._vector.set_rtt(cid, rtt_ms)
+            else:
+                for cid in ids:
+                    await self.arrive(cid, int(band), float(wants))
+            self._base_ids.extend(ids)
         for i, (band, wants) in enumerate(spec.stream_clients):
             client = Client(
                 self._attach, f"w{i}", minimum_refresh_interval=0.0,
@@ -406,6 +468,9 @@ class WorkloadRunner:
             self.note(tick, "master", list(masters))
 
     async def _refresh_clients(self, tick: int) -> None:
+        if self._vector is not None:
+            self._vector.step_refresh(tick)
+            return
         offered: Dict[int, int] = {}
         for cid, client in list(self.clients.items()):
             band = max(
@@ -481,17 +546,20 @@ class WorkloadRunner:
             self.log.append([tick] + v.as_log())
 
     def _measure_bands(self, tick: int) -> Dict[int, float]:
-        wants_by: Dict[int, float] = {}
-        gets_by: Dict[int, float] = {}
-        for client in self.clients.values():
-            for res in client.resources.values():
-                band = int(res.priority)
-                wants_by[band] = wants_by.get(band, 0.0) + float(
-                    res.wants
-                )
-                gets_by[band] = gets_by.get(band, 0.0) + min(
-                    res.current_capacity(), float(res.wants)
-                )
+        if self._vector is not None:
+            wants_by, gets_by = self._vector.measure_bands()
+        else:
+            wants_by = {}
+            gets_by = {}
+            for client in self.clients.values():
+                for res in client.resources.values():
+                    band = int(res.priority)
+                    wants_by[band] = wants_by.get(band, 0.0) + float(
+                        res.wants
+                    )
+                    gets_by[band] = gets_by.get(band, 0.0) + min(
+                        res.current_capacity(), float(res.wants)
+                    )
         sat = {
             band: (gets_by[band] / wants_by[band])
             for band in wants_by if wants_by[band] > 0
@@ -558,7 +626,7 @@ class WorkloadRunner:
                 str(b): round(v, 6) for b, v in sorted(sat.items())
             },
         }
-        rec["population"] = len(self.clients)
+        rec["population"] = self._population_count()
         rec["offered"] = sum(self._offered_by_band.values())
         if self.frontends:
             rec["frontend_held"] = sum(
@@ -578,6 +646,8 @@ class WorkloadRunner:
     # -- reconvergence --------------------------------------------------
 
     def _snapshot(self) -> Dict[str, float]:
+        if self._vector is not None:
+            return self._vector.snapshot(self._base_ids)
         out = {}
         for cid in self._base_ids:
             client = self.clients.get(cid)
@@ -627,7 +697,7 @@ class WorkloadRunner:
                     for g in self.generators:
                         await g.step(tick, self)
                     self._peak_population = max(
-                        self._peak_population, len(self.clients)
+                        self._peak_population, self._population_count()
                     )
                     await self._step_elections(tick)
                     self._drive_federation(tick)

@@ -296,12 +296,88 @@ def flash_crowd_predictive(scale: float = 1.0, seed: int = 0,
     )
 
 
+def _million_population(pop: int) -> list:
+    """The compact (count, band, wants) base rows for a million-client
+    scenario: 60/30/10 across three bands, exact total."""
+    b0 = (pop * 6) // 10
+    b1 = (pop * 3) // 10
+    return [[b0, 0, 1.0], [b1, 1, 2.0], [pop - b0 - b1, 2, 4.0]]
+
+
+# Million-client scenarios: refresh each resident row every
+# MILLION_SPREAD ticks (due set per tick = population / spread), with
+# leases sized to outlive a full wheel lap so nothing expires between
+# refreshes.
+MILLION_SPREAD = 50
+
+
+def diurnal_million(scale: float = 1.0, seed: int = 0,
+                    ticks: Optional[int] = None) -> WorkloadSpec:
+    """Million-client diurnal wave on the array-backed vector engine."""
+    ticks = ticks or 24
+    pop = max(1, int(round(1_000_000 * scale)))
+    return WorkloadSpec.make(
+        "diurnal_million", ticks, seed=seed, capacity=float(pop),
+        lease_length=4.0 * MILLION_SPREAD,
+        population_engine="vector", refresh_spread=MILLION_SPREAD,
+        native_store=True,
+        base_population=_million_population(pop),
+        generators=[
+            # Modest churn rides on top of the parked million: the
+            # arrival wave exercises bulk arrivals/departures without
+            # dominating the resident population.
+            G(
+                "diurnal", curve="0:2,6:8,12:14,18:6,24:2",
+                period=24.0, jitter=0.2,
+                bands=[[0, 1.0], [1, 1.0]], wants=5.0,
+                lifetime_ticks=6, max_population=_pop(scale, 200),
+            ),
+        ],
+        gates={
+            "peak_population": float(pop),
+            "refresh_ok_ratio": 0.95,
+        },
+    )
+
+
+def flash_crowd_million(scale: float = 1.0, seed: int = 0,
+                        ticks: Optional[int] = None) -> WorkloadSpec:
+    """Flash crowd over a parked million-client base; AIMD admission."""
+    ticks = ticks or 20
+    pop = max(1, int(round(1_000_000 * scale)))
+    b1 = pop // 2
+    # AIMD budget sized to the steady due rate (population / spread):
+    # the crowd's extra arrivals push the window over it, so band 0
+    # sheds while the top band rides the goodput floor.
+    steady_rps = max(4.0, pop / MILLION_SPREAD)
+    return WorkloadSpec.make(
+        "flash_crowd_million", ticks, seed=seed, capacity=float(pop),
+        algorithm="PRIORITY_BANDS",
+        lease_length=4.0 * MILLION_SPREAD,
+        population_engine="vector", refresh_spread=MILLION_SPREAD,
+        native_store=True,
+        admission={"max_rps": steady_rps, "min_level": 0.05},
+        base_population=[[pop - b1, 0, 1.0], [b1, 1, 2.0]],
+        generators=[
+            G(
+                "flash_crowd", at=6, duration=6,
+                clients=_pop(scale, 500), band=0, wants=10.0,
+            ),
+        ],
+        gates={
+            "peak_population": float(pop),
+            "top_band_goodput": 0.9,
+        },
+    )
+
+
 SCENARIOS: Dict[str, Callable[..., WorkloadSpec]] = {
     fn.__name__: fn
     for fn in (
         diurnal, flash_crowd, rolling_deploy, multi_region,
         elastic_preempt, flash_crowd_federated, diurnal_streaming,
         diurnal_streaming_pooled, flash_crowd_predictive,
+        diurnal_million, flash_crowd_million,
     )
 }
 
@@ -314,13 +390,41 @@ def scenario_lines() -> list:
     return registry_lines(SCENARIOS)
 
 
-async def _run(spec: WorkloadSpec) -> dict:
-    return await WorkloadRunner(spec).run()
+async def _run(spec: WorkloadSpec, forecaster=None):
+    runner = WorkloadRunner(spec, forecaster=forecaster)
+    return await runner.run(), runner
+
+
+def _warm_forecaster(spec: WorkloadSpec, history):
+    """A forecaster primed from a durable history, or None when the
+    spec is not predictive / the history holds nothing to replay. The
+    model is built exactly as the harness builds its cold one, then
+    `warm_start` replays the recorded per-tick offered stream through
+    `observe` — so the resulting state is bit-identical to having
+    watched that stream live (the pin in
+    tests/test_workload_population.py)."""
+    from doorman_tpu.workload.forecast import SeasonalForecaster
+
+    predictive = spec.predictive_config()
+    if not predictive:
+        return None
+    bands = [int(b) for b in predictive.get("bands", [0, 1])]
+    fc = SeasonalForecaster(
+        series=len(bands),
+        period=int(predictive["period"]),
+        alpha=float(predictive.get("alpha", 0.5)),
+        beta=float(predictive.get("beta", 0.25)),
+        engine=str(predictive.get("engine", "auto")),
+    )
+    fed = fc.warm_start(
+        history, field="offered", interval=float(spec.tick_interval)
+    )
+    return fc if fed else None
 
 
 async def run_scenario_async(
     name: str, *, scale: float = 1.0, seed: int = 0,
-    ticks: Optional[int] = None,
+    ticks: Optional[int] = None, history_dir: Optional[str] = None,
 ) -> dict:
     """Run one named scenario and return its verdict dict.
 
@@ -335,12 +439,32 @@ async def run_scenario_async(
             f"unknown scenario {name!r} (known: {sorted(SCENARIOS)})"
         )
     spec = factory(scale=scale, seed=seed, ticks=ticks)
-    verdict = await _run(spec)
+    history = forecaster = None
+    if history_dir:
+        from doorman_tpu.obs.history import HistoryStore
+
+        # Loading the store replays any prior runs' segments; a
+        # predictive spec warm-starts its forecaster from them.
+        history = HistoryStore(
+            history_dir, component=f"workload:{spec.name}"
+        )
+        forecaster = _warm_forecaster(spec, history)
+    warm_ticks = forecaster.ticks_observed if forecaster else 0
+    verdict, runner = await _run(spec, forecaster=forecaster)
+    if history is not None:
+        verdict["forecaster_warm_start"] = warm_ticks
+        # Re-home this run's flight records as durable segments, so the
+        # NEXT invocation starts where this one's traffic left off.
+        try:
+            for rec in runner.flightrec.snapshot():
+                history.append(rec)
+        finally:
+            history.close()
     if spec.predictive_config():
         reactive_spec = spec.with_(
             predictive={}
         ).with_(name=f"{spec.name}_reactive")
-        reactive = await _run(reactive_spec)
+        reactive, _ = await _run(reactive_spec)
         key = "top_band_satisfaction_stress"
         pair = slo_mod.predictive_goodput_verdict(
             float(verdict["summary"].get(key, 0.0)),
@@ -362,9 +486,13 @@ async def run_scenario_async(
 
 
 def run_scenario(name: str, *, scale: float = 1.0, seed: int = 0,
-                 ticks: Optional[int] = None) -> dict:
+                 ticks: Optional[int] = None,
+                 history_dir: Optional[str] = None) -> dict:
     import asyncio
 
     return asyncio.run(
-        run_scenario_async(name, scale=scale, seed=seed, ticks=ticks)
+        run_scenario_async(
+            name, scale=scale, seed=seed, ticks=ticks,
+            history_dir=history_dir,
+        )
     )

@@ -83,6 +83,26 @@ class WorkloadSpec:
     # -- population -----------------------------------------------------
     # Base clients as (band, wants) pairs, attached before tick 0.
     base_clients: tuple = ()
+    # Compact base population as (count, band, wants) rows — the
+    # million-client form of base_clients (a spec listing 1e6 pairs
+    # would dwarf the run it describes). Expanded after base_clients,
+    # in row order.
+    base_population: tuple = ()
+    # Population engine: "clients" steps one real Client per macro
+    # client per tick (the reference harness path); "vector" holds the
+    # population as arrays (workload.population) and refreshes it in
+    # batched grouped passes against the same servers. At small scale
+    # the two produce byte-identical event logs (the parity pin in
+    # tests/test_workload_population.py).
+    population_engine: str = "clients"
+    # Vector engine only: refresh each client every N ticks (deadline
+    # wheel staggered by row). 1 refreshes everyone every tick — the
+    # per-client path's cadence and the parity default. Million-client
+    # scenarios raise it so a tick's due set is population/N.
+    refresh_spread: int = 1
+    # Back every server's resources with the native C++ store engine
+    # (falls back to the Python store when the build is unavailable).
+    native_store: bool = False
     # Streaming clients as (band, wants) pairs (WatchCapacity leg).
     stream_clients: tuple = ()
     # Serving-plane pool (doorman_tpu/frontend/): N listener workers
@@ -141,7 +161,10 @@ class WorkloadSpec:
                 changes[key] = _freeze(changes[key])
         if "generators" in changes:
             changes["generators"] = tuple(changes["generators"])
-        for key in ("base_clients", "stream_clients", "stress_ticks"):
+        for key in (
+            "base_clients", "base_population", "stream_clients",
+            "stress_ticks",
+        ):
             if key in changes:
                 changes[key] = _freeze(changes[key])
         return replace(self, **changes)
@@ -156,7 +179,10 @@ class WorkloadSpec:
             "admission", "federated", "predictive", "gates",
         ):
             out[key] = _thaw(out[key]) or {}
-        for key in ("base_clients", "stream_clients", "stress_ticks"):
+        for key in (
+            "base_clients", "base_population", "stream_clients",
+            "stress_ticks",
+        ):
             out[key] = _thaw(out[key]) or []
         out["generators"] = [
             {"kind": g.kind, "params": _thaw(g.params) or {}}

@@ -11,6 +11,12 @@ import os
 
 import jax
 
+# grpc's C++ threads write INFO lines (GOAWAY notices and the like)
+# straight to fd 2, bypassing pytest capture; under `2>&1` they splice
+# into the progress dot-lines and corrupt the tier-1 DOTS_PASSED count.
+# Only ERROR-severity output is worth that interleaving.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

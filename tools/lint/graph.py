@@ -46,6 +46,11 @@ CHAOS_ROOTS = (
     "doorman_tpu/frontend/",
     "doorman_tpu/server/",
     "doorman_tpu/sim/",
+    # The workload harness is the other log_sha256-pinned replay
+    # surface: the vector population engine (workload.population) and
+    # its generators draw from the same seeded-determinism contract
+    # the chaos runner enforces.
+    "doorman_tpu/workload/",
 )
 
 # Attribute calls resolved through the unique-method fallback only when
