@@ -3143,7 +3143,7 @@ def bench_workload_scenarios() -> None:
     names = (
         "diurnal", "flash_crowd", "rolling_deploy", "multi_region",
         "elastic_preempt", "flash_crowd_predictive",
-        "diurnal_streaming_pooled",
+        "diurnal_streaming_pooled", "reshard_diurnal",
     )
     for name in names:
         try:

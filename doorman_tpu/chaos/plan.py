@@ -58,6 +58,14 @@ KINDS = frozenset(
         # straddle reconciler while active — its share stops renewing,
         # coasts to its ttl, then the shard decays to zero capacity.
         "shard_partition",
+        # fleet seam (setup["federated"]["fleet"] arms a
+        # FleetController over the provisioned servers): action —
+        # publish a new routing epoch serving params["to"] shards of
+        # the pool. Grow re-splits the straddle shares to include the
+        # new shard; shrink freezes the departed shard's share and
+        # drains it through expiry + lease length (the deliberate
+        # partition). params: {"to": m}.
+        "fleet_reshard",
         # serving-plane seam (setup["frontend_workers"] arms an inline
         # frontend pool; doorman_tpu/frontend/):
         # a listener worker dies while active — its WatchCapacity

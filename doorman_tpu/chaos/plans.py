@@ -312,6 +312,101 @@ def shard_partition() -> FaultPlan:
     )
 
 
+def fleet_reshard_live() -> FaultPlan:
+    """Live resharding 2→3→2 over a provisioned pool of four shards.
+
+    The fleet controller serves the straddling r0 (capacity 120) from
+    an ACTIVE set of two shards; one client per active shard (wants
+    30/20 — underloaded, so the steady state is wants-granted). At tick
+    8 the active set grows to three: the new shard enters the beat
+    with an empty summary and receives an even slack split — nothing
+    restarts, no rows move. At tick 16 it shrinks back: shard 2 leaves
+    the active set, its share freezes (charged against the pool) and
+    drains through expiry + lease length. The acceptance is lease
+    continuity: both clients' grants are byte-unchanged through BOTH
+    handoffs, and Σ shard grants ≤ 120 holds pointwise on every tick
+    (fed_capacity_sum) — the frozen-share drain is exactly what makes
+    the shrink direction safe. Batch mode, so share changes land on
+    all of a shard's grants the very next tick."""
+    return FaultPlan(
+        name="fleet_reshard_live",
+        seed=17,
+        setup={
+            "servers": 4,
+            "federated": {
+                "fleet": True,
+                "active": 2,
+                "straddle": ["r0"],
+                "share_ttl": 2.0,
+                "client_shards": [0, 1],
+            },
+            "clients": 2,
+            "wants": [30.0, 20.0],
+            "capacity": 120,
+            "mode": "batch",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="fleet_reshard",
+                       duration_ticks=0, params={"to": 3}),
+            FaultEvent(at_tick=16, kind="fleet_reshard",
+                       duration_ticks=0, params={"to": 2}),
+        ],
+        warmup_ticks=8,
+        total_ticks=26,
+        reconverge_ticks=4,
+    )
+
+
+def fleet_reshard_partition() -> FaultPlan:
+    """A reshard landing in the middle of a shard partition.
+
+    Three provisioned shards, two active, one client on each (wants
+    30/30 against capacity 90). Shard 1 partitions from the beat at
+    tick 8; while its share is still frozen, the fleet grows to three
+    at tick 10 — the reconciler must split the UNFROZEN remainder
+    between the live shards, keeping s1's frozen share charged, so
+    Σ grants ≤ 90 holds pointwise through the overlap of partition and
+    reshard. s1's client degrades as its shard's capacity decays (the
+    plan's degraded marker); s0's client rides through byte-unchanged
+    (shard_blast_radius). At heal the beat reaches s1 again, re-grants
+    its share, and allocations reconverge within budget."""
+    return FaultPlan(
+        name="fleet_reshard_partition",
+        seed=18,
+        setup={
+            "servers": 3,
+            "federated": {
+                "fleet": True,
+                "active": 2,
+                "straddle": ["r0"],
+                "share_ttl": 2.0,
+                "client_shards": [0, 1],
+            },
+            "clients": 2,
+            "wants": [30.0, 30.0],
+            "capacity": 90,
+            "mode": "batch",
+            "lease_length": 60,
+            "refresh_interval": 1,
+            "learning_mode_duration": 0,
+            "election_ttl": 3.0,
+        },
+        events=[
+            FaultEvent(at_tick=8, kind="shard_partition", target="s1",
+                       duration_ticks=6),
+            FaultEvent(at_tick=10, kind="fleet_reshard",
+                       duration_ticks=0, params={"to": 3}),
+        ],
+        warmup_ticks=8,
+        total_ticks=28,
+        reconverge_ticks=8,
+    )
+
+
 def grant_corruption() -> FaultPlan:
     """The shadow-oracle audit's proving ground: a batch server under
     steady overload (FAIR_SHARE, wants 110 vs capacity 100, so the
@@ -476,6 +571,8 @@ PLANS: Dict[str, "callable"] = {
     ),
     "client_storm": client_storm,
     "etcd_brownout": etcd_brownout,
+    "fleet_reshard_live": fleet_reshard_live,
+    "fleet_reshard_partition": fleet_reshard_partition,
     "frontend_worker_crash": frontend_worker_crash,
     "frontend_ring_stall": frontend_ring_stall,
     "grant_corruption": grant_corruption,

@@ -58,7 +58,7 @@ LOCK = "/chaos/master"
 RESOURCE = "r0"
 # Events of these kinds happen once when applied, instead of arming a
 # fault window on the switchboard.
-ACTIONS = frozenset({"kv_expire_lock", "port_bind"})
+ACTIONS = frozenset({"kv_expire_lock", "port_bind", "fleet_reshard"})
 
 
 class SteppedElection(Election):
@@ -372,7 +372,24 @@ class ChaosRunner:
             self.proxies[name] = proxy
             self.elections[name] = election
 
-        if fed:
+        if fed and fed.get("fleet"):
+            # Fleet runtime: every configured server is PROVISIONED,
+            # only the first `active` serve the beat; fleet_reshard
+            # events move the boundary live.
+            from doorman_tpu.fleet import FleetController
+
+            self.federation = FleetController(
+                {
+                    i: self.servers[f"s{i}"]
+                    for i in range(int(s.get("servers", 1)))
+                },
+                straddle=fed.get("straddle", ()),
+                overrides=fed.get("overrides"),
+                active=fed.get("active"),
+                share_ttl=float(fed.get("share_ttl", 2.0)),
+                clock=self.clock,
+            )
+        elif fed:
             from doorman_tpu.federation import FederatedRoots, ShardRouter
 
             router = ShardRouter(
@@ -486,6 +503,12 @@ class ChaosRunner:
             self.kv.expire(LOCK)
         elif ev.kind == "port_bind":
             self.bound_ports.append(self.ports.bind())
+        elif ev.kind == "fleet_reshard":
+            # Live reshard: publish the new epoch now; this tick's
+            # reconcile beat (which runs after events apply) already
+            # sees the new active set.
+            change = self.federation.reshard(int(ev.params["to"]))
+            self.log.append([tick, "fleet_epoch", change.as_log()])
         else:
             self.state.start(ev)
         self._faults_counter.inc(ev.kind)

@@ -235,6 +235,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "to hold a few ticks of push traffic — a "
                         "worker that falls a full ring behind laps and "
                         "resets its streams loudly")
+    p.add_argument("--frontend-tls-cert", default="",
+                   help="TLS certificate file for the frontend worker "
+                        "pool: each SO_REUSEPORT worker terminates TLS "
+                        "on the public port (the loopback backend hop "
+                        "stays plaintext). Defaults to --tls-cert when "
+                        "--frontend-workers is set")
+    p.add_argument("--frontend-tls-key", default="",
+                   help="TLS key file for the frontend worker pool "
+                        "(see --frontend-tls-cert); defaults to "
+                        "--tls-key when --frontend-workers is set")
     p.add_argument("--stream-shards", type=int, default=1,
                    help="stream push: partition subscribers across "
                         "this many fanout shards (stable client-id "
@@ -254,6 +264,24 @@ def make_parser() -> argparse.ArgumentParser:
                         "Every candidate of one shard passes the SAME "
                         "value; clients route with the same N "
                         "(doc/federation.md)")
+    p.add_argument("--fleet-beat", default="",
+                   help="fleet head address: run the straddle-share "
+                        "reporter — each interval this shard sweeps "
+                        "its straddling resources, reports the compact "
+                        "demand summaries as one GetServerCapacity "
+                        "(server_id 'fleet-shard-<k>') and installs "
+                        "the response leases as its shares. Needs "
+                        "--shard (the k) and --fleet-straddle "
+                        "(doc/federation.md, doc/operations.md)")
+    p.add_argument("--fleet-straddle", default="",
+                   help="comma-separated resource ids whose capacity "
+                        "straddles every fleet shard (must match the "
+                        "head's list and the clients' router)")
+    p.add_argument("--fleet-report-interval", type=float, default=2.0,
+                   help="seconds between beat reports; the head's "
+                        "share ttl should be a small multiple of this "
+                        "or healthy shards flap to zero between "
+                        "renewals")
     p.add_argument("--native-store", action="store_true",
                    help="back lease stores with the C++ engine "
                         "(doorman_tpu/native; falls back to the Python "
@@ -417,10 +445,14 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
             log.error("--frontend-workers needs --stream-push (the "
                       "workers exist to hold WatchCapacity streams)")
             raise SystemExit(2)
-        if args.tls_cert or args.tls_key:
-            log.error("--frontend-workers does not serve TLS yet; "
-                      "terminate TLS in front of the pool or drop "
-                      "--tls-cert/--tls-key")
+        # TLS terminates at the workers: the dedicated flag pair wins,
+        # falling back to --tls-cert/--tls-key so a single-process
+        # deployment's flags keep working when the pool is turned on.
+        fe_cert = args.frontend_tls_cert or args.tls_cert
+        fe_key = args.frontend_tls_key or args.tls_key
+        if bool(fe_cert) != bool(fe_key):
+            log.error("--frontend-tls-cert and --frontend-tls-key "
+                      "must both be set")
             raise SystemExit(2)
         # Construct BEFORE start(): the pool's control surface
         # (Establish/Drop/Heartbeat) registers on the backend gRPC
@@ -430,6 +462,8 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
             ring_bytes=args.frontend_ring_bytes,
             inline=False,
             ramp_window=args.coalesce_window if args.admission else 0.0,
+            tls_cert=fe_cert or None,
+            tls_key=fe_key or None,
         )
 
     if frontend is not None:
@@ -512,9 +546,45 @@ async def serve(args: argparse.Namespace, on_started=None) -> None:
 
     await server.wait_until_configured()
     log.info("configured; serving")
+
+    reporter = None
+    reporter_task = None
+    if args.fleet_beat:
+        from doorman_tpu.fleet.rpc import ShardReporter
+
+        if shard is None:
+            log.error("--fleet-beat needs --shard (the reporter's "
+                      "fleet-shard-<k> identity)")
+            raise SystemExit(2)
+        straddle = [
+            r.strip() for r in args.fleet_straddle.split(",") if r.strip()
+        ]
+        if not straddle:
+            log.error("--fleet-beat needs --fleet-straddle (which "
+                      "resources the beat reconciles)")
+            raise SystemExit(2)
+        reporter = ShardReporter(
+            server, shard, args.fleet_beat, straddle,
+            interval=args.fleet_report_interval,
+        )
+        # Bootstrap corollary (doc/federation.md): one report BEFORE
+        # serving traffic installs the even zero-demand split, so this
+        # shard never serves a straddling resource against the full
+        # template capacity. Best-effort — a head that is not up yet
+        # just means the loop's first landing report bootstraps.
+        await reporter.step()
+        reporter_task = asyncio.create_task(reporter.run())
+        log.info("fleet beat reporter: shard %d -> %s every %.1fs "
+                 "(%d straddling resources)", shard, args.fleet_beat,
+                 args.fleet_report_interval, len(straddle))
+
     try:
         await asyncio.Event().wait()  # serve forever
     finally:
+        if reporter_task is not None:
+            reporter_task.cancel()
+        if reporter is not None:
+            await reporter.close()
         if config_task is not None:
             config_task.cancel()
         if debug is not None:

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Iterable, Optional
 
 from doorman_tpu.client.client import Client, ClientResource
 from doorman_tpu.federation.discovery import ShardDiscovery
@@ -104,6 +104,38 @@ class FederatedClient:
             )
         client = await self._client(shard)
         return await client.resource(resource_id, wants, priority=priority)
+
+    async def apply_epoch(
+        self, router: ShardRouter, moved: Iterable[str] = ()
+    ) -> dict:
+        """Adopt a new routing epoch (fleet reshard). Swaps the router
+        and re-homes exactly this client's claims on the `moved`
+        resources: the live ClientResource object — lease included —
+        migrates to the new owner's per-shard client, so the next
+        refresh reports the same `has` there and the new owner's
+        learning-mode warm-up carries the grant across (lease
+        continuity; doc/federation.md). Everything else is untouched:
+        unmoved shards' clients keep their connections and cache
+        entries, so an epoch bump causes at most one Discovery
+        resolution (the new shard), never a stampede."""
+        self.router = router
+        rehomed = []
+        for rid in moved:
+            if router.is_straddling(rid):
+                continue
+            new_shard = router.shard_of(rid)
+            for shard, client in list(self._clients.items()):
+                if shard == new_shard:
+                    continue
+                res = client.resources.pop(rid, None)
+                if res is None:
+                    continue
+                target = await self._client(new_shard)
+                res._client = target
+                target.resources[rid] = res
+                target._wake.set()
+                rehomed.append(rid)
+        return {"rehomed": rehomed}
 
     async def refresh_once(self) -> bool:
         """One fan-out refresh: every shard client runs one bulk

@@ -98,6 +98,19 @@ class ShardDiscovery:
         self._cache[shard] = (addr, self._deadline(now))
         return addr
 
+    def add_seeds(
+        self, seeds: Mapping[int, Union[str, Sequence[str]]]
+    ) -> None:
+        """Extend the seed map in place — the fleet supervisor calls
+        this when a reshard activates shards that did not exist when
+        the cache was built. Existing entries are replaced; cached
+        resolutions are NOT touched (a new seed list says nothing
+        about who is master right now)."""
+        for shard, addrs in seeds.items():
+            if isinstance(addrs, str):
+                addrs = (addrs,)
+            self._seeds[int(shard)] = tuple(addrs)
+
     def note_master(self, shard: int, addr: str) -> None:
         """Invalidate-on-redirect: a live connection just learned the
         shard's real master from a mastership redirect — that IS the

@@ -206,12 +206,19 @@ class FrontendPool:
     def __init__(self, server, workers: int, *,
                  ring_bytes: int = 1 << 22,
                  tick_interval: float = 1.0,
-                 respawn: bool = True):
+                 respawn: bool = True,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         self.server = server
         self.workers = int(workers)
         self.ring_bytes = int(ring_bytes)
         self.tick_interval = float(tick_interval)
         self.respawn = respawn
+        # TLS terminates at the workers (the listener edge); paths are
+        # handed to each spawned worker, which reads them itself — so
+        # a respawn after cert rotation picks the new pair up.
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         registry = server._streams
         if registry is None:
             raise ValueError("frontend pool needs stream push enabled")
@@ -259,7 +266,9 @@ class FrontendPool:
             target=run_worker,
             args=(w, self.public_addr, self.backend_addr,
                   self._ring_names[w], self.ring_bytes),
-            kwargs={"tick_interval": self.tick_interval},
+            kwargs={"tick_interval": self.tick_interval,
+                    "tls_cert": self.tls_cert,
+                    "tls_key": self.tls_key},
             name=f"doorman-frontend-w{w}",
             daemon=True,
         )

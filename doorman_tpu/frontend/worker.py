@@ -296,23 +296,28 @@ def run_worker(
     tick_interval: float = 1.0,
     poll_interval: float = 0.05,
     heartbeat_interval: float = 1.0,
+    tls_cert: "str | None" = None,
+    tls_key: "str | None" = None,
 ) -> None:
     """Entry point of one listener worker PROCESS (spawn target —
     workers never import jax, and a spawned interpreter keeps it that
     way). Serves the public port with SO_REUSEPORT, pumps the shared
-    ring, forwards unary RPCs and establishment to `backend_addr`."""
+    ring, forwards unary RPCs and establishment to `backend_addr`.
+    A `tls_cert`/`tls_key` file pair terminates TLS at the worker; the
+    backend forward stays loopback-plaintext by design."""
     logging.basicConfig(
         level=logging.INFO,
         format=f"%(asctime)s %(levelname).1s frontend-w{index}: "
                "%(message)s",
     )
     uv = _install_uvloop()
-    log.info("worker %d: uvloop=%s public=%s backend=%s",
-             index, uv, public_addr, backend_addr)
+    log.info("worker %d: uvloop=%s public=%s backend=%s tls=%s",
+             index, uv, public_addr, backend_addr, bool(tls_cert))
     asyncio.run(_worker_serve(
         index, public_addr, backend_addr, ring_name, ring_capacity,
         tick_interval=tick_interval, poll_interval=poll_interval,
         heartbeat_interval=heartbeat_interval,
+        tls_cert=tls_cert, tls_key=tls_key,
     ))
 
 
@@ -326,6 +331,8 @@ async def _worker_serve(
     tick_interval: float,
     poll_interval: float,
     heartbeat_interval: float,
+    tls_cert: "str | None" = None,
+    tls_key: "str | None" = None,
 ) -> None:
     import signal
     import time
@@ -479,9 +486,23 @@ async def _worker_serve(
             CAPACITY_SERVICE, handlers
         ),
     ))
-    server.add_insecure_port(public_addr)
+    if tls_cert and tls_key:
+        # TLS terminates HERE, at the listener edge: every worker
+        # serves the same cert pair on the shared SO_REUSEPORT socket,
+        # and only the loopback backend hop stays plaintext
+        # (doc/serving.md). Files are read in-process so a cert
+        # rotation needs only a worker respawn, not a pool rebuild.
+        with open(tls_key, "rb") as f:
+            key_bytes = f.read()
+        with open(tls_cert, "rb") as f:
+            cert_bytes = f.read()
+        creds = grpc.ssl_server_credentials([(key_bytes, cert_bytes)])
+        server.add_secure_port(public_addr, creds)
+    else:
+        server.add_insecure_port(public_addr)
     await server.start()
-    log.info("worker %d serving %s", index, public_addr)
+    log.info("worker %d serving %s (tls=%s)", index, public_addr,
+             bool(tls_cert))
 
     # Graceful drain: SIGTERM stops accepting, ends held streams (the
     # _CLOSE fan-out below), and lets in-flight unary forwards finish

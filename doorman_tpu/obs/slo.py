@@ -577,6 +577,13 @@ _WORKLOAD_GATES: Dict[str, tuple] = {
         "violations",
         "federated capacity-sum invariant violations (must be 0)",
     ),
+    "epoch_changes": (
+        "min",
+        {"type": "scalar", "key": "epoch_changes"},
+        "changes",
+        "fleet routing-epoch changes applied (resharding visibly "
+        "happened)",
+    ),
     "stream_pushes": (
         "min",
         {"type": "scalar", "key": "stream_pushes"},

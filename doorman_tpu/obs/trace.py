@@ -101,6 +101,10 @@ KNOWN_SPAN_NAMES = frozenset({
     # WatchCapacity stream's serve loop.
     "frontend.pump",
     "frontend.stream",
+    # Fleet runtime (doorman_tpu/fleet): one reconcile beat — the
+    # controller's pull sweep, or one shard report folding into the
+    # head's BeatCore on the wire deployment.
+    "fleet.beat",
 })
 KNOWN_INSTANT_NAMES = frozenset({
     "election.transition",
@@ -118,6 +122,10 @@ KNOWN_INSTANT_NAMES = frozenset({
     # A frontend worker declaring a held stream stalled (its ring
     # frame overdue past the stall margin) before resetting it.
     "frontend.stall",
+    # Fleet runtime: a published routing epoch (live reshard) and one
+    # shard-side beat report installing its returned shares.
+    "fleet.epoch",
+    "fleet.report",
 })
 
 # The process time axis: perf_counter at import. Chrome trace `ts` must

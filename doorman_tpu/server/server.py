@@ -142,10 +142,18 @@ class CapacityServer(CapacityServicer):
             "straddle_updates": 0,
             "straddle_capacity": 0.0,
             "upstream_rpcs": 0,
+            "fleet_redirects": 0,
         }
         # resource id -> this shard's currently installed share (feeds
         # fed_stats["straddle_capacity"] as the sum over resources).
         self._straddle_shares: Dict[str, float] = {}
+        # Fleet routing: resources an epoch moved AWAY from this shard,
+        # mapped to the new owner's address ("" when unknown — the
+        # client falls back to discovery). Replaced whole per epoch by
+        # set_fleet_routing, so a resource that moves back simply
+        # drops out of the table.
+        self._fleet_routing: Dict[str, str] = {}
+        self._fleet_epoch = 0
         self.election = election
         self.mode = mode
         self.tick_interval = tick_interval
@@ -452,7 +460,9 @@ class CapacityServer(CapacityServicer):
 
     def attach_frontend(self, workers: int, *, ring_bytes: int = 1 << 20,
                         inline: bool = True, ramp_window: float = 0.0,
-                        stall_margin: float = 3.0):
+                        stall_margin: float = 3.0,
+                        tls_cert: Optional[str] = None,
+                        tls_key: Optional[str] = None):
         """Attach the serving-plane pool (doorman_tpu.frontend): N
         listener workers over per-worker push rings, plus the
         establishment ramp. `inline=True` builds the deterministic
@@ -480,6 +490,7 @@ class CapacityServer(CapacityServicer):
             self._frontend = FrontendPool(
                 self, workers, ring_bytes=ring_bytes,
                 tick_interval=self.tick_interval,
+                tls_cert=tls_cert, tls_key=tls_key,
             )
         self._frontend_ramp = EstablishmentRamp(window=ramp_window)
         return self._frontend
@@ -1128,6 +1139,25 @@ class CapacityServer(CapacityServicer):
             sum(self._straddle_shares.values())
         )
 
+    def set_fleet_routing(
+        self, epoch: int, routed_away: Dict[str, str]
+    ) -> None:
+        """Fleet hook: install the epoch's redirect table — every
+        resource this shard no longer owns, mapped to the new owner's
+        dial address. The table REPLACES the previous epoch's (the
+        fleet controller computes it from the full tracked set, so a
+        resource that moved back is simply absent). A stale-epoch
+        client refreshing a moved resource here gets a mastership
+        redirect to the new owner instead of a silently wrong grant;
+        its rows on this shard drain by plain lease expiry."""
+        epoch = int(epoch)
+        if epoch < self._fleet_epoch:
+            # An out-of-order install from a slow controller RPC must
+            # not roll the table back to an older epoch's map.
+            return
+        self._fleet_epoch = epoch
+        self._fleet_routing = dict(routed_away)
+
     def persist_step(self) -> None:
         """One durability beat (journal flush + cadenced snapshot +
         compaction) when persistence is configured and this server is
@@ -1731,6 +1761,23 @@ class CapacityServer(CapacityServicer):
                 if not self.is_master:
                     out.mastership.CopyFrom(self._mastership())
                     return out
+                if self._fleet_routing:
+                    # Epoch-aware redirect: a reshard moved one of the
+                    # requested resources off this shard. Answer with a
+                    # mastership redirect to the new owner (clients
+                    # batch per shard, so a mixed batch is a stale
+                    # router — chasing re-sorts it).
+                    moved = next(
+                        (req.resource_id for req in request.resource
+                         if req.resource_id in self._fleet_routing),
+                        None,
+                    )
+                    if moved is not None:
+                        self.fed_stats["fleet_redirects"] += 1
+                        addr = self._fleet_routing[moved]
+                        if addr:
+                            out.mastership.master_address = addr
+                        return out
                 msg = config_mod.validate_get_capacity_request(request)
                 if msg is not None:
                     err = True

@@ -28,7 +28,14 @@ Registry kinds:
   * ``trace``        — replays a recorded arrival log (inline
                        ``events`` rows or a ``loadtest.storm --record``
                        JSONL file): real traffic shapes re-run against
-                       the virtual-clock harness, deterministically.
+                       the virtual-clock harness, deterministically;
+  * ``reshard``      — a fixed routing-epoch schedule for fleet specs
+                       ([[tick, shards], ...] applied through
+                       harness.fleet_reshard);
+  * ``autoscale``    — the SLO-driven elastic shard count: per-tick
+                       satisfaction verdicts feed fleet.Autoscaler
+                       (hysteresis + cool-down) and its decisions
+                       become live reshards.
 """
 
 from __future__ import annotations
@@ -358,11 +365,101 @@ class TraceReplay(Generator):
             harness.note(tick, "trace_arrive", len(arrivals))
 
 
+class ReshardSchedule(Generator):
+    """A fixed routing-epoch schedule for fleet specs: ``schedule``
+    rows of [tick, shards] applied in order through
+    harness.fleet_reshard. Draws no randomness — the schedule IS the
+    policy (the autoscale generator is the closed-loop variant)."""
+
+    kind = "reshard"
+
+    def __init__(self, params: dict):
+        super().__init__(params)
+        self.schedule = {
+            int(t): int(m)
+            for t, m in self.params.get("schedule", [])
+        }
+        if not self.schedule:
+            raise ValueError("reshard generator needs a schedule")
+
+    async def step(self, tick: int, harness) -> None:
+        target = self.schedule.get(tick)
+        if target is not None:
+            harness.fleet_reshard(target)
+
+
+class AutoscaleFleet(Generator):
+    """SLO-driven elastic shard count. After each tick's refreshes the
+    generator renders the tick's satisfaction as a min-kind verdict
+    against ``target`` (observed < target fails; margin = observed -
+    target) and feeds it to a fleet.Autoscaler — sustained failure
+    grows the active set by ``scale_step``, sustained pass with at
+    least ``shrink_margin`` headroom shrinks it, hysteresis and
+    cool-down guard against flapping. Decisions apply immediately via
+    harness.fleet_reshard, so the NEXT beat re-splits the straddle
+    shares over the new active set. Deterministic: satisfaction is
+    plan arithmetic and the autoscaler draws no randomness."""
+
+    kind = "autoscale"
+
+    def __init__(self, params: dict):
+        super().__init__(params)
+        p = self.params
+        from doorman_tpu.fleet import Autoscaler
+
+        self.target = float(p.get("target", 0.9))
+        self.scaler = Autoscaler(
+            min_shards=int(p["min_shards"]),
+            max_shards=int(p["max_shards"]),
+            step=int(p.get("scale_step", 1)),
+            hysteresis=int(p.get("hysteresis", 3)),
+            cooldown=int(p.get("cooldown", 6)),
+            shrink_margin=float(p.get("shrink_margin", 0.0)),
+        )
+
+    async def after_refresh(self, tick: int, harness) -> None:
+        # _measure_bands runs after the generators' after_refresh, so
+        # measure this tick's satisfaction directly (same arithmetic).
+        if harness._vector is not None:
+            wants_by, gets_by = harness._vector.measure_bands()
+        else:
+            wants_by = {}
+            gets_by = {}
+            for client in harness.clients.values():
+                for res in client.resources.values():
+                    band = int(res.priority)
+                    wants_by[band] = wants_by.get(band, 0.0) + float(
+                        res.wants
+                    )
+                    gets_by[band] = gets_by.get(band, 0.0) + min(
+                        res.current_capacity(), float(res.wants)
+                    )
+        total_wants = sum(wants_by.values())
+        if total_wants <= 0:
+            return
+        observed = sum(gets_by.values()) / total_wants
+        verdict = {
+            "slo": "autoscale:satisfaction",
+            "status": "pass" if observed >= self.target else "fail",
+            "margin": observed - self.target,
+        }
+        decided = self.scaler.observe(
+            tick, [verdict], harness.federation.active
+        )
+        if decided is not None:
+            harness.note(
+                tick, "autoscale",
+                self.scaler.decisions[-1]["reason"],
+                harness.federation.active, decided,
+            )
+            harness.fleet_reshard(decided)
+
+
 GENERATORS = {
     cls.kind: cls
     for cls in (
         DiurnalArrivals, FlashCrowd, RollingDeploy, MultiRegionRtt,
-        ElasticJobs, TraceReplay,
+        ElasticJobs, TraceReplay, ReshardSchedule, AutoscaleFleet,
     )
 }
 

@@ -242,6 +242,25 @@ class WorkloadRunner:
             res.current_capacity() for res in client.resources.values()
         )
 
+    def fleet_reshard(self, n_shards: int) -> None:
+        """Publish a new routing epoch serving `n_shards` of the
+        provisioned pool (fleet specs only). The generator owns the
+        policy (fixed schedule or autoscaler); the harness just applies
+        it, counts it, and logs the change deterministically."""
+        if self.federation is None or not hasattr(
+            self.federation, "reshard"
+        ):
+            raise ValueError(
+                "fleet_reshard needs a fleet federated spec "
+                '({"fleet": True, ...})'
+            )
+        change = self.federation.reshard(int(n_shards))
+        self.bump("epoch_changes")
+        self.note(
+            self._tick, "fleet_epoch", change.epoch,
+            change.n_from, change.n_to,
+        )
+
     async def deploy(self, server_index: int, down_ticks: int) -> None:
         """Take one server down for a graceful rolling-deploy window:
         abdicate mastership, release its lock, and stay out of the
@@ -336,7 +355,28 @@ class WorkloadRunner:
             self.proxies[name] = proxy
             self.elections[name] = election
 
-        if fed:
+        if fed and fed.get("fleet"):
+            # Fleet runtime: all spec.servers are PROVISIONED shards,
+            # the first `active` serve; generators move the boundary
+            # live through harness.fleet_reshard (routing epochs).
+            from doorman_tpu.fleet import FleetController
+
+            self.federation = FleetController(
+                {
+                    i: self.servers[f"s{i}"]
+                    for i in range(int(spec.servers))
+                },
+                straddle=tuple(fed.get("straddle", (spec.resource,))),
+                overrides=fed.get("overrides"),
+                active=fed.get("active"),
+                addrs={
+                    i: self.proxies[f"s{i}"].address
+                    for i in range(int(spec.servers))
+                },
+                share_ttl=float(fed.get("share_ttl", 2.0)),
+                clock=self.clock,
+            )
+        elif fed:
             from doorman_tpu.federation import FederatedRoots, ShardRouter
 
             router = ShardRouter(
@@ -628,6 +668,13 @@ class WorkloadRunner:
         }
         rec["population"] = self._population_count()
         rec["offered"] = sum(self._offered_by_band.values())
+        if self.federation is not None and hasattr(
+            self.federation, "epoch"
+        ):
+            # The fleet's routing state on the black box: an operator
+            # lines a grant wiggle up with the epoch that caused it.
+            rec["fleet_epoch"] = self.federation.epoch
+            rec["fleet_active"] = self.federation.active
         if self.frontends:
             rec["frontend_held"] = sum(
                 pool.held() for pool in self.frontends.values()
@@ -737,6 +784,9 @@ class WorkloadRunner:
             "fed_capacity_violations": float(self._fed_violations),
             "completions": float(self.counters.get("completions", 0)),
             "preemptions": float(self.counters.get("preemptions", 0)),
+            "epoch_changes": float(
+                self.counters.get("epoch_changes", 0)
+            ),
         }
         if self.frontends or self._frontend_final:
             scalars["frontend_frames"] = float(self._frontend_frames)

@@ -371,13 +371,67 @@ def flash_crowd_million(scale: float = 1.0, seed: int = 0,
     )
 
 
+def reshard_diurnal(scale: float = 1.0, seed: int = 0,
+                    ticks: Optional[int] = None) -> WorkloadSpec:
+    """Diurnal wave over an elastic fleet: the autoscale generator
+    grows the active shard set 2→4 when the morning ramp starves
+    satisfaction, and shrinks back 4→2 once the evening decay restores
+    headroom — with the straddle capacity-sum and top-band leases
+    pinned through both routing-epoch changes."""
+    ticks = ticks or 48
+    cap = 260.0 * scale
+    # Straddling resources must decompose into compact per-shard
+    # summaries, so the fleet runs the proportional default (the
+    # reconciler rejects PRIORITY_BANDS straddles by design).
+    return WorkloadSpec.make(
+        "reshard_diurnal", ticks, seed=seed, servers=4,
+        capacity=cap,
+        federated={
+            "fleet": True,
+            "active": 2,
+            "straddle": ["r0"],
+            "client_shards": [0, 1],
+        },
+        base_clients=[(2, 30.0 * scale), (2, 30.0 * scale)],
+        generators=[
+            G(
+                "diurnal",
+                # Sharp day: quiet, a steep morning ramp that
+                # overloads the pool, a fast evening decay so the
+                # shrink leg fires well before the run ends.
+                curve="0:1,10:10,22:12,30:2,48:1",
+                period=48.0, jitter=0.2,
+                bands=[[0, 2.0], [1, 1.0]],
+                wants=8.0 * scale, lifetime_ticks=6,
+                max_population=_pop(scale, 100),
+            ),
+            G(
+                "autoscale", target=0.85, min_shards=2, max_shards=4,
+                scale_step=2, hysteresis=3, cooldown=6,
+                shrink_margin=0.05,
+            ),
+        ],
+        gates={
+            # Both legs of the 2→4→2 arc visibly happened...
+            "epoch_changes": 2.0,
+            # ...without ever over-admitting across the fleet...
+            "fed_capacity_violations": 0.0,
+            # ...while the resident leases ride through both
+            # routing-epoch changes and refreshes keep landing.
+            "top_band_satisfaction": 0.8,
+            "refresh_ok_ratio": 0.9,
+            "get_capacity_p99_ms": 250.0,
+        },
+    )
+
+
 SCENARIOS: Dict[str, Callable[..., WorkloadSpec]] = {
     fn.__name__: fn
     for fn in (
         diurnal, flash_crowd, rolling_deploy, multi_region,
         elastic_preempt, flash_crowd_federated, diurnal_streaming,
         diurnal_streaming_pooled, flash_crowd_predictive,
-        diurnal_million, flash_crowd_million,
+        diurnal_million, flash_crowd_million, reshard_diurnal,
     )
 }
 

@@ -345,10 +345,17 @@ async def _eight_lane_server(ticks):
         for rid in RIDS:
             await c.resource(rid, wants=wants)
         clients.append(c)
-    for _ in range(ticks):
+    # Drive until `ticks` solves have APPLIED: the resident lane
+    # pipelines dispatch, so the first tick_once stages without
+    # landing and the audit hook (keyed on applied ticks) would
+    # otherwise see one fewer aligned sample than the loop count.
+    for _ in range(ticks + 4):
+        if server._ticks_done >= ticks:
+            break
         await server.tick_once()
         for c in clients:
             await c.refresh_once()
+    assert server._ticks_done >= ticks
     return server, clients
 
 

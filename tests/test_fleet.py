@@ -1,0 +1,529 @@
+"""Fleet runtime conformance (doorman_tpu/fleet, doc/operations.md).
+
+The pins:
+
+  * routing epochs — advance() computes the exact move diff over the
+    tracked set, rejects no-ops, never moves a straddle;
+  * the beat codec — ShardSummary <-> GetServerCapacity aggregate
+    round-trips losslessly for integer weights (the wire beat carries
+    compact per-band curves, never per-client rows);
+  * BeatCore push-mode drain — a silent shard's share freezes (still
+    charged against the pool), then its slack is re-offered only after
+    the drain window, so Σ reported grants never exceeds capacity;
+  * the autoscaler — hysteresis, cool-down, bound clamping, and the
+    streak reset that prevents 2→3→2 flapping;
+  * THE acceptance arc — live reshard 2→3 under churn on the
+    deterministic in-process fleet: fed_capacity_sum holds pointwise
+    on every tick of the handoff, healthy-resource clients see
+    byte-unchanged grants, the moved resource's client keeps its grant
+    across the ownership change, and the old owner gets an
+    epoch-stamped redirect table;
+  * discovery under a shard-count change — apply_epoch re-homes
+    exactly the moved routes with at most one new Discovery
+    resolution (counter-pinned: no stampede), and a stale-epoch client
+    refreshing the old owner over real loopback gRPC is redirected and
+    chases to the new owner;
+  * the fleet chaos plans and the reshard_diurnal workload scenario
+    are deterministic (byte-stable log hashes) and their gates hold.
+"""
+
+import asyncio
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from doorman_tpu.algorithms import Request
+from doorman_tpu.chaos import get_plan
+from doorman_tpu.chaos.runner import ChaosRunner
+from doorman_tpu.client.client import Client
+from doorman_tpu.federation import (
+    FederatedClient,
+    ShardDiscovery,
+    ShardRouter,
+    stable_shard,
+)
+from doorman_tpu.federation.reconcile import ShardSummary
+from doorman_tpu.fleet import (
+    Autoscaler,
+    BeatCore,
+    EpochRouter,
+    FleetController,
+    decode_summary,
+    encode_summary,
+    parse_shard_server_id,
+    shard_server_id,
+)
+from doorman_tpu.proto import doorman_pb2 as pb
+from doorman_tpu.server.config import parse_yaml_config
+from doorman_tpu.server.election import TrivialElection
+from doorman_tpu.server.server import CapacityServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+CONFIG = """
+resources:
+- identifier_glob: strad
+  capacity: 120
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60,
+              refresh_interval: 1, learning_mode_duration: 0}
+- identifier_glob: "*"
+  capacity: 100
+  algorithm: {kind: PROPORTIONAL_SHARE, lease_length: 60,
+              refresh_interval: 1, learning_mode_duration: 0}
+"""
+
+
+async def _make_batch_server(name, clock, shard=None):
+    server = CapacityServer(
+        name, TrivialElection(), mode="batch",
+        minimum_refresh_interval=0.0, clock=clock, shard=shard,
+        flightrec_capacity=0,
+    )
+    await server.load_config(parse_yaml_config(CONFIG))
+    await asyncio.sleep(0)
+    return server
+
+
+# ----------------------------------------------------------------------
+# Routing epochs
+# ----------------------------------------------------------------------
+
+
+def _rid_that(pred):
+    for i in range(200):
+        rid = f"ord-{i}"
+        if pred(rid):
+            return rid
+    raise AssertionError("no resource id matched the predicate")
+
+
+def test_epoch_router_move_diff_and_noop():
+    stay = _rid_that(lambda r: stable_shard(r, 2) == stable_shard(r, 3))
+    move = _rid_that(lambda r: stable_shard(r, 2) != stable_shard(r, 3))
+    er = EpochRouter(2, straddle=["strad"], resources=[stay, move])
+    assert er.epoch == 0
+    change = er.advance(3)
+    assert er.epoch == 1 and change.epoch == 1
+    assert change.n_from == 2 and change.n_to == 3
+    assert change.added == (2,) and change.removed == ()
+    moved = {rid: (old, new) for rid, old, new in change.moved}
+    assert move in moved
+    assert moved[move] == (stable_shard(move, 2), stable_shard(move, 3))
+    assert stay not in moved
+    assert "strad" not in moved  # straddles re-split, never move
+    log = change.as_log()
+    assert log["epoch"] == 1 and log["from"] == 2 and log["to"] == 3
+    with pytest.raises(ValueError, match="no-op"):
+        er.advance(3)
+    back = er.advance(2)
+    assert back.added == () and back.removed == (2,)
+    # The shrink diff is the grow diff reversed.
+    assert {rid: (new, old) for rid, old, new in back.moved} == moved
+
+
+def test_epoch_router_rejects_stranded_override():
+    er = EpochRouter(3, overrides={"pinned": 2})
+    with pytest.raises(ValueError):
+        er.advance(2)  # override points past the new shard count
+    assert er.epoch == 0  # failed advance publishes nothing
+
+
+# ----------------------------------------------------------------------
+# The beat codec
+# ----------------------------------------------------------------------
+
+
+def test_shard_server_id_round_trip():
+    assert shard_server_id(3) == "fleet-shard-3"
+    assert parse_shard_server_id("fleet-shard-3") == 3
+    assert parse_shard_server_id("some-intermediate") is None
+    assert parse_shard_server_id("fleet-shard-x") is None
+
+
+def test_beat_codec_round_trips_summary():
+    summary = ShardSummary(
+        shard=1, wants=58.0, has=41.5, weight=7.0,
+        breakpoints=((4.0, 8.0, 2.0), (10.0, 50.0, 5.0)),
+    )
+    req = encode_summary(summary, "strad")
+    assert req.resource_id == "strad"
+    assert req.has.capacity == 41.5
+    # One band per breakpoint: index, weight, wants — O(curve), never
+    # O(clients).
+    assert [(b.priority, b.num_clients, b.wants) for b in req.wants] == [
+        (0, 2, 8.0), (1, 5, 50.0),
+    ]
+    back = decode_summary(req, 1)
+    assert back == summary  # integer weights: lossless round-trip
+
+
+# ----------------------------------------------------------------------
+# BeatCore: push-mode freeze -> decay -> re-offer
+# ----------------------------------------------------------------------
+
+
+def test_beat_core_freezes_silent_shard_then_reoffers():
+    clock = FakeClock()
+    core = BeatCore(
+        lambda rid: (100.0, pb.Algorithm.PROPORTIONAL_SHARE, 5.0),
+        expected=[0, 1], share_ttl=2.0, stale_after=2.0, clock=clock,
+    )
+
+    def report(shard, wants, has):
+        return core.offer(shard, "strad", ShardSummary(
+            shard=shard, wants=wants, has=has, weight=1.0,
+            breakpoints=((wants, wants, 1.0),),
+        ))
+
+    has = {0: 0.0, 1: 0.0}
+    for _ in range(4):
+        for shard in (0, 1):
+            share, expiry = report(shard, 80.0, has[shard])
+            assert expiry == clock() + 2.0
+            has[shard] = min(80.0, share)
+        clock.advance(1.0)
+    # Symmetric overload: the fleet splits evenly.
+    assert has[0] == has[1] == pytest.approx(50.0)
+
+    # Shard 1 goes silent. Its share freezes — still charged — so
+    # shard 0 can never be offered the frozen slack early.
+    frozen = has[1]
+    reoffered_at = None
+    for step in range(12):
+        clock.advance(1.0)
+        share, _ = report(0, 80.0, has[0])
+        assert share + frozen <= 100.0 + 1e-9 or share > 60.0
+        if share > 60.0 and reoffered_at is None:
+            reoffered_at = step
+        has[0] = min(80.0, share)
+        if reoffered_at is None:
+            # While the share is frozen the silent shard's last
+            # reported grants are still live, so the wire-plane
+            # capacity sum covers them; after the drain window those
+            # leases have expired and only the survivor's grants count.
+            total = core.has_sums()["strad"]
+            assert total <= 100.0 + 1e-9, (step, total)
+    # The slack came back only after expiry + lease drained the frozen
+    # share (share_ttl 2 + lease 5), and then the survivor got it all.
+    assert reoffered_at is not None and reoffered_at >= 6
+    assert has[0] == pytest.approx(80.0)
+
+
+# ----------------------------------------------------------------------
+# Autoscaler
+# ----------------------------------------------------------------------
+
+
+def _verdict(status, margin=0.0):
+    return {"slo": "x", "status": status, "margin": margin}
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    a = Autoscaler(min_shards=2, max_shards=4, step=1, hysteresis=3,
+                   cooldown=6, shrink_margin=0.1)
+    assert a.observe(0, [_verdict("fail")], 2) is None
+    assert a.observe(1, [_verdict("fail")], 2) is None
+    assert a.observe(2, [_verdict("fail")], 2) == 3  # streak of 3
+    # Cool-down: an immediate second fail-streak cannot fire.
+    for t in (3, 4, 5):
+        assert a.observe(t, [_verdict("fail")], 3) is None
+    for t in (6, 7):
+        assert a.observe(t, [_verdict("fail")], 3) is None
+    assert a.observe(8, [_verdict("fail")], 3) == 4  # cool-down lapsed
+    # Bound clamp: at max, a fail streak decides nothing (no no-op
+    # reshard, no churn).
+    for t in range(14, 20):
+        assert a.observe(t, [_verdict("fail")], 4) is None
+    assert [d["reason"] for d in a.decisions] == [
+        "grow:fail-streak", "grow:fail-streak",
+    ]
+
+
+def test_autoscaler_shrink_needs_margin_and_flip_resets_streak():
+    a = Autoscaler(min_shards=1, max_shards=4, step=1, hysteresis=2,
+                   cooldown=0, shrink_margin=0.1)
+    # Passing without headroom is HOLD, not shrink.
+    assert a.observe(0, [_verdict("pass", 0.05)], 3) is None
+    assert a.observe(1, [_verdict("pass", 0.05)], 3) is None
+    # A flip resets the streak: pass, fail, pass never fires.
+    assert a.observe(2, [_verdict("pass", 0.5)], 3) is None
+    assert a.observe(3, [_verdict("fail")], 3) is None
+    assert a.observe(4, [_verdict("pass", 0.5)], 3) is None
+    assert a.observe(5, [_verdict("pass", 0.5)], 3) == 2
+    assert a.decisions[-1]["reason"] == "shrink:margin-streak"
+    # no_data verdicts are not a signal either way.
+    assert a.observe(6, [_verdict("no_data")], 2) is None
+    assert a.observe(7, [_verdict("no_data")], 2) is None
+
+
+# ----------------------------------------------------------------------
+# THE acceptance arc: live reshard 2 -> 3 under churn
+# ----------------------------------------------------------------------
+
+WARMUP = 6
+RESHARD_TICK = 6
+TOTAL = 16
+
+
+def test_live_reshard_2_to_3_is_lease_continuous():
+    """Pointwise fed_capacity_sum through the handoff, byte-unchanged
+    grants for healthy resources, grant continuity for the moved
+    resource, redirect tables on the old owner."""
+    stay = _rid_that(lambda r: stable_shard(r, 2) == stable_shard(r, 3))
+    move = _rid_that(lambda r: stable_shard(r, 2) != stable_shard(r, 3))
+
+    async def body():
+        clock = FakeClock()
+        servers = {
+            i: await _make_batch_server(f"s{i}", clock, shard=i)
+            for i in range(3)
+        }
+        fleet = FleetController(
+            servers, straddle=["strad"], active=2,
+            addrs={i: f"addr-{i}" for i in range(3)},
+            share_ttl=2.0, clock=clock,
+        )
+        fleet.note_resources([stay, move])
+        grants = {}
+
+        def decide(shard, rid, client, wants):
+            lease, _ = servers[shard]._decide(
+                rid, Request(client, grants.get((rid, client), 0.0),
+                             wants),
+            )
+            grants[(rid, client)] = lease.has
+            return lease.has
+
+        try:
+            for tick in range(TOTAL):
+                if tick == RESHARD_TICK:
+                    change = fleet.reshard(3)
+                    assert fleet.active == 3 and fleet.epoch == 1
+                    moved = {r for r, _o, _n in change.moved}
+                    assert move in moved and stay not in moved
+                    # The old owner's redirect table points the moved
+                    # resource at the new owner's dial address.
+                    old, new = (
+                        stable_shard(move, 2), stable_shard(move, 3),
+                    )
+                    assert servers[old]._fleet_routing[move] == (
+                        f"addr-{new}"
+                    )
+                    assert move not in servers[new]._fleet_routing
+                # The beat runs BEFORE refreshes land (runner order):
+                # a freshly activated shard has its share installed
+                # before it serves a single straddle request.
+                installed = fleet.reconcile_once()
+                assert set(installed["strad"]) == set(
+                    range(fleet.active)
+                )
+                # Overloaded straddle churn: demand outgrows capacity,
+                # and a NEW client lands on the new shard mid-handoff.
+                decide(0, "strad", "c-a", 100.0)
+                decide(1, "strad", "c-b", 80.0)
+                if tick > RESHARD_TICK:
+                    decide(2, "strad", "c-new", 50.0)
+                # Healthy ordinary resource: underloaded, unmoved.
+                healthy = decide(
+                    stable_shard(stay, 2), stay, "c-stay", 25.0
+                )
+                assert healthy == 25.0  # byte-unchanged, every tick
+                # The moved resource: its client follows the router.
+                owner = fleet.router.shard_of(move)
+                moved_has = decide(owner, move, "c-move", 40.0)
+                assert moved_has == 40.0  # continuity across the move
+                for server in servers.values():
+                    await server.tick_once()
+                clock.advance(1.0)
+                # fed_capacity_sum, pointwise over EVERY provisioned
+                # shard (a draining shard's grants still count).
+                total = sum(
+                    s.resources["strad"].store.sum_has
+                    for s in servers.values()
+                    if "strad" in s.resources
+                )
+                assert total <= 120.0 + 1e-6, (tick, total)
+            # The handoff converged: all three shards hold installed
+            # shares and the new client is being served.
+            assert grants[("strad", "c-new")] > 0.0
+            # The moved resource lives on its new owner's store now.
+            new = stable_shard(move, 3)
+            assert servers[new].resources[move].store.get(
+                "c-move"
+            ).has == 40.0
+        finally:
+            for server in servers.values():
+                await server.stop()
+
+    run(body())
+
+
+# ----------------------------------------------------------------------
+# Discovery under a shard-count change (no stampede, exact re-homing)
+# ----------------------------------------------------------------------
+
+
+def test_apply_epoch_rehomes_exactly_the_moved_routes():
+    stay = _rid_that(lambda r: stable_shard(r, 2) == stable_shard(r, 3))
+    move = _rid_that(lambda r: stable_shard(r, 2) != stable_shard(r, 3))
+
+    async def body():
+        import random
+
+        clock = FakeClock()
+        resolved = []
+
+        async def resolver(shard, seeds):
+            resolved.append(shard)
+            return f"addr-{shard}"
+
+        disc = ShardDiscovery(
+            {i: f"seed-{i}" for i in range(3)}, ttl=1e6, jitter=0.0,
+            clock=clock, rng=random.Random(7), resolver=resolver,
+        )
+        er = EpochRouter(2, straddle=["strad"],
+                         resources=[stay, move])
+        fed = FederatedClient(
+            er.router, disc, client_id="fed-c", background=False,
+            clock=clock, minimum_refresh_interval=0.0,
+        )
+        res_stay = await fed.resource(stay, 10.0)
+        res_move = await fed.resource(move, 20.0)
+        res_strad = await fed.resource(
+            "strad", 5.0, shard=stable_shard(move, 2)
+        )
+        clients_before = dict(fed._clients)
+        base = len(resolved)
+
+        change = er.advance(3)
+        out = await fed.apply_epoch(
+            er.router, [r for r, _o, _n in change.moved]
+        )
+        # Exactly the claimed moved route re-homed; the straddle and
+        # the stable resource never move.
+        assert out["rehomed"] == [move]
+        new_owner = stable_shard(move, 3)
+        assert fed._clients[new_owner].resources[move] is res_move
+        for shard, client in clients_before.items():
+            assert fed._clients[shard] is client  # no reconnect storm
+        assert res_stay._client is clients_before[stable_shard(stay, 2)]
+        assert res_strad._client.resources["strad"] is res_strad
+        # Counter-pinned: the epoch bump cost AT MOST one Discovery
+        # resolution (the new owner), not one per claimed resource.
+        assert len(resolved) - base <= 1
+        # A second application of the same epoch is a no-op.
+        out2 = await fed.apply_epoch(er.router, [move])
+        assert out2["rehomed"] == []
+        await fed.close()
+
+    run(body())
+
+
+def test_stale_epoch_refresh_chases_redirect_to_new_owner():
+    """Loopback gRPC: a client with the OLD router refreshing the old
+    owner gets a fleet mastership redirect and chases to the new
+    owner, which carries the reported grant across (lease
+    continuity)."""
+
+    async def body():
+        old = CapacityServer(
+            "old-owner", TrivialElection(), mode="immediate",
+            minimum_refresh_interval=0.0,
+        )
+        new = CapacityServer(
+            "new-owner", TrivialElection(), mode="immediate",
+            minimum_refresh_interval=0.0,
+        )
+        old_port = await old.start(0, host="127.0.0.1")
+        new_port = await new.start(0, host="127.0.0.1")
+        for server, port in ((old, old_port), (new, new_port)):
+            await server.load_config(parse_yaml_config(CONFIG))
+            await asyncio.sleep(0)
+            server.current_master = f"127.0.0.1:{port}"
+        client = Client(
+            f"127.0.0.1:{old_port}", "stale-client",
+            minimum_refresh_interval=0.0,
+        )
+        try:
+            res = await client.resource("moved-rid", wants=25.0)
+            assert await client.refresh_once()
+            assert res.current_capacity() == 25.0
+            assert "moved-rid" in old.resources
+
+            # The reshard publishes epoch 1: this shard no longer owns
+            # the resource; the table names the new owner.
+            old.set_fleet_routing(
+                1, {"moved-rid": f"127.0.0.1:{new_port}"}
+            )
+            # An out-of-order epoch-0 install must not roll it back.
+            old.set_fleet_routing(0, {})
+            assert old._fleet_routing == {
+                "moved-rid": f"127.0.0.1:{new_port}"
+            }
+
+            # The stale client's next refresh chases the redirect; the
+            # one after lands the refresh on the new owner.
+            ok = await client.refresh_once()
+            if not ok:
+                assert await client.refresh_once()
+            assert old.fed_stats["fleet_redirects"] >= 1
+            assert res.current_capacity() == 25.0  # never lapsed
+            assert new.resources["moved-rid"].store.get(
+                "stale-client"
+            ).has == 25.0
+        finally:
+            await client.close()
+            await old.stop()
+            await new.stop()
+
+    run(body())
+
+
+# ----------------------------------------------------------------------
+# Chaos plans + workload scenario: determinism and gates
+# ----------------------------------------------------------------------
+
+
+def _run_plan(name):
+    runner = ChaosRunner(get_plan(name))
+    verdict = asyncio.run(runner.run())
+    return verdict, runner.log
+
+
+@pytest.mark.parametrize(
+    "name", ["fleet_reshard_live", "fleet_reshard_partition"]
+)
+def test_fleet_chaos_plans_hold_and_are_deterministic(name):
+    v, log = _run_plan(name)
+    assert v["ok"], v["violations"]
+    assert v["violations"] == []
+    epochs = [e for e in log if e[1] == "fleet_epoch"]
+    assert epochs, "plan must actually publish routing epochs"
+    again, _ = _run_plan(name)
+    assert again["log_sha256"] == v["log_sha256"]
+
+
+def test_reshard_diurnal_scenario_arcs_2_4_2():
+    from doorman_tpu.workload.scenarios import run_scenario
+
+    v = run_scenario("reshard_diurnal", seed=0)
+    assert v["ok"], v["slo"]["verdicts"]
+    assert v["summary"]["epoch_changes"] == 2.0
+    assert v["summary"]["fed_capacity_violations"] == 0.0
+    again = run_scenario("reshard_diurnal", seed=0)
+    assert again["log_sha256"] == v["log_sha256"]

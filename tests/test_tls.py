@@ -85,6 +85,88 @@ def test_tls_end_to_end(certs):
     asyncio.run(body())
 
 
+@needs_openssl
+def test_frontend_workers_terminate_tls(certs):
+    """TLS terminates at the spawned listener workers: a secure
+    channel pinning the self-signed root completes the loopback
+    handshake and gets a grant through the worker's unary forward
+    (the backend hop stays plaintext by design), while a plaintext
+    client against the same port fails instead of hanging."""
+    import socket
+    import time
+
+    import grpc
+
+    from doorman_tpu.proto import doorman_pb2 as pb
+    from doorman_tpu.proto.grpc_api import CapacityStub
+
+    cert, key = certs
+
+    async def body():
+        server = CapacityServer(
+            "tls-frontend", TrivialElection(), mode="immediate",
+            tick_interval=0.2, minimum_refresh_interval=0.0,
+            stream_push=True, stream_shards=2,
+        )
+        pool = server.attach_frontend(
+            1, ring_bytes=1 << 18, inline=False,
+            tls_cert=cert, tls_key=key,
+        )
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            public_port = s.getsockname()[1]
+        public_addr = f"127.0.0.1:{public_port}"
+        try:
+            backend_port = await server.start(0, host="127.0.0.1")
+            await server.load_config(parse_yaml_config(CONFIG))
+            await asyncio.sleep(0)
+            server.current_master = public_addr
+            await pool.start(public_addr, f"127.0.0.1:{backend_port}")
+
+            # The spawned worker takes a moment to import grpc and
+            # bind; ready means it has heartbeat the control surface.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if pool.control.status()["worker_held"]:
+                    break
+                await asyncio.sleep(0.2)
+            else:
+                raise TimeoutError("worker never became ready")
+
+            with open(cert, "rb") as f:
+                root = f.read()
+            creds = grpc.ssl_channel_credentials(root_certificates=root)
+            async with grpc.aio.secure_channel(
+                f"localhost:{public_port}", creds
+            ) as ch:
+                stub = CapacityStub(ch)
+                req = pb.GetCapacityRequest(client_id="tls-fe-client")
+                rr = req.resource.add()
+                rr.resource_id = "r0"
+                rr.wants = 25.0
+                rr.priority = 1
+                resp = await asyncio.wait_for(
+                    stub.GetCapacity(req), timeout=30
+                )
+                assert resp.response[0].gets.capacity == 25.0
+
+            # Plaintext against the TLS port: loud handshake failure,
+            # not a hang.
+            async with grpc.aio.insecure_channel(public_addr) as ch:
+                stub = CapacityStub(ch)
+                with pytest.raises(
+                    (grpc.aio.AioRpcError, asyncio.TimeoutError)
+                ):
+                    await asyncio.wait_for(
+                        stub.GetCapacity(req), timeout=5
+                    )
+        finally:
+            await pool.stop()
+            await server.stop()
+
+    asyncio.run(body())
+
+
 def test_tls_requires_both_cert_and_key():
     async def body():
         server = CapacityServer("s", TrivialElection())

@@ -51,6 +51,10 @@ CHAOS_ROOTS = (
     # its generators draw from the same seeded-determinism contract
     # the chaos runner enforces.
     "doorman_tpu/workload/",
+    # The fleet runtime: the chaos runner and workload harness drive
+    # FleetController (reconcile beat, routing epochs, autoscaler)
+    # inside the same log_sha256-pinned replays.
+    "doorman_tpu/fleet/",
 )
 
 # Attribute calls resolved through the unique-method fallback only when
